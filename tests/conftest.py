@@ -1,0 +1,24 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+This is the capability the reference's test suite lacks entirely (its
+MPI path silently degrades to no-ops when ``num_procs()==1``, ref
+``sac/mpi.py:79-80,94-95``, so no distributed code is ever exercised in
+CI — SURVEY.md §4). Forcing 8 XLA host devices gives real
+``shard_map``/``psum`` collective semantics to every distributed test
+without TPU hardware.
+
+Must set env vars before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,90 @@
+"""Shape tests for the visual (mixed-observation) stack.
+
+Covers the reference's ``tests/test_convolutional.py`` surface —
+VisualActor unbatched, VisualCritic batched + unbatched (auto-reshape
+paths) — with the wall-runner dimensions (168 features, 64x64x3 frame,
+56 actions; ref ``environments/wall_runner.py:20-21``), plus the
+conv-size helper against reference-computed values.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torch_actor_critic_tpu.core.types import MultiObservation
+from torch_actor_critic_tpu.models import (
+    VisualActor,
+    VisualCritic,
+    VisualDoubleCritic,
+    conv_output_size,
+)
+
+OBS_DIM, ACT_DIM = 168, 56
+FRAME = (64, 64, 3)  # HWC
+
+
+def _obs(batch=None):
+    key = jax.random.key(0)
+    if batch is None:
+        features = jax.random.normal(key, (OBS_DIM,))
+        frame = jax.random.randint(key, FRAME, 0, 256, dtype=jnp.uint8)
+    else:
+        features = jax.random.normal(key, (batch, OBS_DIM))
+        frame = jax.random.randint(key, (batch,) + FRAME, 0, 256, dtype=jnp.uint8)
+    return MultiObservation(features=features, frame=frame)
+
+
+def test_conv_output_size_matches_atari_trunk():
+    # 64x64 through k8s4 -> 15, k4s2 -> 6, k3s1 -> 4; 64*4*4 = 1024.
+    assert conv_output_size((64, 64), (32, 64, 64), (8, 4, 3), (4, 2, 1)) == 1024
+    # 84x84 Atari classic: 84 -> 20 -> 9 -> 7; 64*7*7 = 3136.
+    assert conv_output_size((84, 84), (32, 64, 64), (8, 4, 3), (4, 2, 1)) == 3136
+
+
+def test_visual_actor_unbatched():
+    actor = VisualActor(act_dim=ACT_DIM, hidden_sizes=(32, 32))
+    obs = _obs()
+    params = actor.init(jax.random.key(0), obs, jax.random.key(1))
+    action, logp = actor.apply(params, obs, jax.random.key(2))
+    assert action.shape == (ACT_DIM,)
+    assert logp.shape == ()
+
+
+def test_visual_actor_batched():
+    actor = VisualActor(act_dim=ACT_DIM, hidden_sizes=(32, 32))
+    obs = _obs(batch=4)
+    params = actor.init(jax.random.key(0), obs, jax.random.key(1))
+    action, logp = actor.apply(params, obs, jax.random.key(2))
+    assert action.shape == (4, ACT_DIM)
+    assert logp.shape == (4,)
+
+
+def test_visual_critic_batched_and_unbatched():
+    critic = VisualCritic(hidden_sizes=(32, 32))
+    obs_b = _obs(batch=2)
+    act_b = jnp.zeros((2, ACT_DIM))
+    params = critic.init(jax.random.key(0), obs_b, act_b)
+    q = critic.apply(params, obs_b, act_b)
+    assert q.shape == (2,)
+
+    q1 = critic.apply(params, _obs(), jnp.zeros((ACT_DIM,)))
+    assert q1.shape == ()
+
+
+def test_visual_double_critic():
+    critic = VisualDoubleCritic(hidden_sizes=(32, 32), num_qs=2)
+    obs = _obs(batch=3)
+    act = jnp.zeros((3, ACT_DIM))
+    params = critic.init(jax.random.key(0), obs, act)
+    q = critic.apply(params, obs, act)
+    assert q.shape == (2, 3)
+    assert not np.allclose(np.asarray(q[0]), np.asarray(q[1]))
+
+
+def test_wider_cnn_features():
+    """cnn_features > 1 (the recommended deviation) must flow end-to-end."""
+    actor = VisualActor(act_dim=ACT_DIM, hidden_sizes=(32,), cnn_features=64)
+    obs = _obs(batch=2)
+    params = actor.init(jax.random.key(0), obs, jax.random.key(1))
+    action, logp = actor.apply(params, obs, jax.random.key(2))
+    assert action.shape == (2, ACT_DIM)

@@ -1,0 +1,41 @@
+"""TPU-native Soft Actor-Critic framework.
+
+A ground-up JAX/XLA re-design of the capability surface of
+``dogeplusplus/torch-actor-critic`` (reference at ``/root/reference``):
+
+- Squashed-Gaussian MLP actor + twin Q-critics (ref ``networks/linear.py``)
+  and a CNN variant for mixed proprioceptive+pixel observations
+  (ref ``networks/convolutional.py``) -> :mod:`torch_actor_critic_tpu.models`
+  as Flax modules.
+- Uniform-sampling ring replay buffers (ref ``buffer/``) ->
+  :mod:`torch_actor_critic_tpu.buffer` as HBM-resident device arrays with
+  functional ``push``/``sample``.
+- Synchronous data-parallel SAC over MPI (ref ``sac/mpi.py``,
+  ``sac/algorithm.py``) -> one fused, jitted update step with
+  ``lax.pmean`` gradient averaging over a ``jax.sharding.Mesh``
+  (:mod:`torch_actor_critic_tpu.parallel`,
+  :mod:`torch_actor_critic_tpu.sac`).
+- MLflow experiment tracking + checkpoint/resume (ref ``main.py``) ->
+  file-based tracking (:mod:`torch_actor_critic_tpu.utils.tracking`) and
+  Orbax checkpointing of the full train state *including* the replay
+  buffer, target params and PRNG key — a strict superset of the
+  reference's persisted state (which drops buffer + target critic,
+  ref ``sac/algorithm.py:164-180``).
+- dm_control wall-runner gym env + eval CLI (ref
+  ``environments/wall_runner.py``, ``run_agent.py``) ->
+  :mod:`torch_actor_critic_tpu.envs`,
+  ``torch_actor_critic_tpu/run_agent.py``.
+
+Design: functional core, stateful shell. Everything numeric is a pure
+pytree-in/pytree-out function under ``jit``; only env stepping and
+checkpoint/metrics IO live on the host.
+"""
+
+__version__ = "0.1.0"
+
+from torch_actor_critic_tpu.core.types import (  # noqa: F401
+    Batch,
+    BufferState,
+    MultiObservation,
+    TrainState,
+)

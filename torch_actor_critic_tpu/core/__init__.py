@@ -1,0 +1,6 @@
+from torch_actor_critic_tpu.core.types import (  # noqa: F401
+    Batch,
+    BufferState,
+    MultiObservation,
+    TrainState,
+)

@@ -1,0 +1,116 @@
+"""Core pytree types shared by every layer of the framework.
+
+These replace the reference's three observation/batch containers —
+``Batch`` (ref ``buffer/replay_buffer.py:8-14``), ``VisualBatch``
+(ref ``buffer/visual_replay_buffer.py:12-19``) and ``MultiObservation``
+(ref ``environments/wall_runner.py:11-14``) — with JAX pytrees. In the
+reference, ``MultiObservation`` lives in the *environment* layer and is
+imported upward by the networks and buffers (ref
+``networks/convolutional.py:11``, ``buffer/visual_replay_buffer.py:9``);
+here it is a core struct so every layer depends downward only.
+
+Because an observation is "whatever pytree the env emits" (a flat
+``jax.Array`` for proprioceptive envs, a :class:`MultiObservation` for
+mixed pixel envs), one ``Batch`` type covers both the reference's
+``Batch`` and ``VisualBatch``, and the networks/buffers/losses are
+written once over generic observation pytrees.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import optax
+from flax import struct
+
+
+@struct.dataclass
+class MultiObservation:
+    """Mixed proprioceptive + pixel observation.
+
+    ``features`` is a flat float vector (ref wall-runner emits 168 dims,
+    ``environments/wall_runner.py:21``); ``frame`` is an image. The
+    reference stores CHW float frames; we store **HWC uint8** (TPU/XLA
+    conv layouts prefer NHWC, and uint8 storage cuts replay HBM by 4x —
+    the cast to float happens on-device at sample time).
+    """
+
+    features: jax.Array
+    frame: jax.Array
+
+
+# An observation is an arbitrary pytree of arrays; the two concrete
+# shapes used by the built-in models:
+Observation = t.Union[jax.Array, MultiObservation]
+
+
+@struct.dataclass
+class Batch:
+    """A batch of transitions (or a chunk of them to push into a buffer).
+
+    Mirrors the field layout of the reference ``Batch``
+    (ref ``buffer/replay_buffer.py:8-14``); ``states``/``next_states``
+    are observation pytrees so the same struct serves the visual stack
+    (ref ``buffer/visual_replay_buffer.py:12-19``).
+    """
+
+    states: Observation
+    actions: jax.Array
+    rewards: jax.Array
+    next_states: Observation
+    done: jax.Array
+
+
+@struct.dataclass
+class BufferState:
+    """Functional replay-buffer state: preallocated device arrays + cursor.
+
+    The reference keeps ``ptr``/``size``/``max_size`` as Python ints on a
+    host NumPy ring (ref ``buffer/replay_buffer.py:17-27``); here they are
+    traced scalars so ``push``/``sample`` compile into the fused update
+    step. ``data`` holds one leading ``capacity`` axis per leaf.
+    """
+
+    data: Batch
+    ptr: jax.Array  # int32 scalar: next write slot
+    size: jax.Array  # int32 scalar: number of valid rows (<= capacity)
+
+    @property
+    def capacity(self) -> int:
+        return jax.tree_util.tree_leaves(self.data)[0].shape[0]
+
+
+@struct.dataclass
+class TrainState:
+    """The complete SAC learner state as one pytree.
+
+    The union of everything the reference scatters across mutable
+    objects: actor/critic module params (ref ``main.py:54-97``), the
+    deep-copied target critic (ref ``sac/algorithm.py:194-196``), two
+    Adam states (ref ``main.py:93-95``), the epoch/step counters, plus —
+    new here — a learned entropy-temperature state (the reference fixes
+    ``alpha=0.2``, ref ``main.py:148``) and the PRNG key (the reference
+    seeds global RNGs per rank, ref ``sac/algorithm.py:203-205``).
+
+    Checkpointing this one pytree with Orbax persists strictly more than
+    the reference's MLflow save (which drops target critic and buffer,
+    ref ``sac/algorithm.py:164-180``).
+    """
+
+    step: jax.Array  # int32: gradient steps taken
+    actor_params: t.Any
+    critic_params: t.Any
+    target_critic_params: t.Any
+    pi_opt_state: optax.OptState
+    q_opt_state: optax.OptState
+    log_alpha: jax.Array  # scalar; exp() is the entropy temperature
+    alpha_opt_state: optax.OptState
+    rng: jax.Array
+
+
+def tree_stack(trees: t.Sequence[t.Any]) -> t.Any:
+    """Stack a list of identical pytrees along a new leading axis."""
+    import numpy as np
+
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *trees)

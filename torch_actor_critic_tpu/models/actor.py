@@ -1,0 +1,53 @@
+"""Squashed-Gaussian MLP actor.
+
+Behavioral twin of the reference ``Actor`` (ref
+``networks/linear.py:13-53``): ReLU MLP trunk, separate ``mu`` /
+``log_std`` linear heads, log-std clipped to ``[-20, 2]``,
+reparameterized sample, ``tanh * act_limit`` squash, softplus-form
+log-prob correction — all via :mod:`torch_actor_critic_tpu.ops.distributions`.
+
+TPU-native differences: a pure function of (params, obs, key) — the
+PRNG key is explicit, so action selection jits and vmaps freely, and
+``deterministic`` / ``with_logprob`` are static arguments that compile
+to distinct (smaller) XLA programs rather than runtime branches.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+from flax import linen as nn
+
+from torch_actor_critic_tpu.models.mlp import MLP, Dense
+from torch_actor_critic_tpu.ops.distributions import squashed_gaussian_sample
+
+
+class Actor(nn.Module):
+    """SquashedGaussian policy head over an MLP trunk.
+
+    Attributes mirror the reference constructor
+    (ref ``networks/linear.py:14-30``); ``act_limit`` defaults to 1.0
+    (standard MuJoCo) rather than the reference's 10 — the train CLI
+    passes the env's real limit exactly as the reference's
+    ``init_session`` does (ref ``main.py:97``).
+    """
+
+    act_dim: int
+    hidden_sizes: t.Sequence[int] = (256, 256)
+    act_limit: float = 1.0
+
+    @nn.compact
+    def __call__(
+        self,
+        obs: jax.Array,
+        key: jax.Array | None = None,
+        deterministic: bool = False,
+        with_logprob: bool = True,
+    ):
+        trunk = MLP(self.hidden_sizes, activate_final=True)(obs)
+        mu = Dense(self.act_dim)(trunk)
+        log_std = Dense(self.act_dim)(trunk)
+        return squashed_gaussian_sample(
+            key, mu, log_std, self.act_limit, deterministic, with_logprob
+        )

@@ -1,0 +1,79 @@
+"""MLP trunk builder.
+
+The TPU-native counterpart of the reference's list-of-``nn.Linear``
+factory (ref ``networks/core.py:6-10``; activations applied by callers,
+ref ``networks/linear.py:33-35``). Here the trunk is a single Flax
+module — a chain of ``Dense`` layers the XLA compiler fuses into MXU
+matmuls with the ReLUs folded into the epilogues.
+
+Initializers match torch ``nn.Linear`` defaults
+(``U(-1/sqrt(fan_in), 1/sqrt(fan_in))`` for both kernel and bias) so
+that our runs are distribution-identical to reference runs at init —
+important for the ±5% return-parity gate in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+def torch_linear_kernel_init(key: jax.Array, shape: t.Sequence[int], dtype=jnp.float32):
+    """torch ``nn.Linear``/``nn.Conv2d`` weight init: kaiming-uniform(a=sqrt(5))
+    == ``U(-1/sqrt(fan_in), 1/sqrt(fan_in))``. Works for both Flax Dense
+    kernels ``(fan_in, fan_out)`` and Conv kernels ``(kh, kw, in, out)``:
+    fan_in is the product of all but the last axis."""
+    fan_in = int(np.prod(shape[:-1]))
+    bound = 1.0 / np.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+def torch_linear_bias_init(fan_in: int):
+    """torch ``nn.Linear``/``nn.Conv2d`` bias init:
+    ``U(-1/sqrt(fan_in), 1/sqrt(fan_in))``."""
+
+    def init(key: jax.Array, shape: t.Sequence[int], dtype=jnp.float32):
+        bound = 1.0 / np.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+class Dense(nn.Module):
+    """``nn.Dense`` with torch-default initialization."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        fan_in = x.shape[-1]
+        return nn.Dense(
+            self.features,
+            kernel_init=torch_linear_kernel_init,
+            bias_init=torch_linear_bias_init(fan_in),
+        )(x)
+
+
+class MLP(nn.Module):
+    """Plain ReLU MLP.
+
+    ``hidden_sizes`` are the layer widths; ReLU after every layer when
+    ``activate_final`` (the actor trunk, ref ``networks/linear.py:33-35``),
+    or after all but the last (the critic, ref ``networks/linear.py:63-67``).
+    """
+
+    hidden_sizes: t.Sequence[int]
+    activate_final: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        n = len(self.hidden_sizes)
+        for i, width in enumerate(self.hidden_sizes):
+            x = Dense(width)(x)
+            if self.activate_final or i < n - 1:
+                x = nn.relu(x)
+        return x

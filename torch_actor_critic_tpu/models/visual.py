@@ -1,0 +1,247 @@
+"""CNN models for mixed proprioceptive + pixel observations.
+
+Behavioral twins of the reference visual stack
+(ref ``networks/convolutional.py``):
+
+- :func:`conv_output_size` — flattened conv-stack output size
+  (ref ``calculate_size``, ``convolutional.py:14-27``).
+- :class:`SimpleCNN` — Atari-DQN trunk (filters [32,64,64], kernels
+  [8,4,3], strides [4,2,1], VALID padding) -> Flatten -> Dense(512) ->
+  Dense(out_features) (ref ``simple_cnn``, ``convolutional.py:30-51``,
+  whose ``out_features`` is hardwired to **1**: the whole image becomes
+  a single scalar).
+- :class:`VisualActor` / :class:`VisualCritic` / :class:`VisualDoubleCritic`
+  (ref ``convolutional.py:54-183``).
+
+TPU-native differences:
+
+- **NHWC layout** (uint8 HWC frames from the env, cast to float on
+  device) instead of the reference's NCHW float frames — XLA:TPU's
+  native conv layout; uint8 replay storage is 4x smaller in HBM.
+- ``cnn_features`` is configurable. The default 1 reproduces the
+  reference's scalar-vision bottleneck exactly (parity mode); widening
+  it (e.g. 64) is the recommended deliberate deviation flagged in
+  SURVEY.md §7 item 2.
+- The twin visual critic is a vmapped parameter ensemble like
+  :class:`~torch_actor_critic_tpu.models.critic.DoubleCritic`, not two
+  sequential submodules (ref ``convolutional.py:167-183``).
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from torch_actor_critic_tpu.core.types import MultiObservation
+from torch_actor_critic_tpu.models.mlp import (
+    MLP,
+    Dense,
+    torch_linear_bias_init,
+    torch_linear_kernel_init,
+)
+from torch_actor_critic_tpu.ops.distributions import squashed_gaussian_sample
+
+
+def conv_output_size(
+    image_hw: t.Tuple[int, int],
+    filters: t.Sequence[int],
+    kernel_sizes: t.Sequence[int],
+    strides: t.Sequence[int],
+) -> int:
+    """Flattened size after the VALID-padded conv stack.
+
+    Same recurrence as the reference ``calculate_size``
+    (ref ``convolutional.py:14-27``): ``d' = floor((d - k) / s + 1)``
+    per spatial dim, channels replaced by the filter count.
+    """
+    h, w = image_hw
+    c = filters[0]
+    for f, k, s in zip(filters, kernel_sizes, strides):
+        c = f
+        h = int(np.floor((h - k) / s + 1))
+        w = int(np.floor((w - k) / s + 1))
+    return int(c * h * w)
+
+
+class SimpleCNN(nn.Module):
+    """Conv trunk -> Flatten -> Dense(dense_size) -> Dense(out_features).
+
+    Expects NHWC input; uint8 frames are cast to float32 on entry (raw
+    0-255 scale by default for parity — the reference never rescales
+    pixels, ref ``wall_runner.py:54-59`` + ``visual_replay_buffer.py:52-58``).
+    """
+
+    filters: t.Sequence[int] = (32, 64, 64)
+    kernel_sizes: t.Sequence[int] = (8, 4, 3)
+    strides: t.Sequence[int] = (4, 2, 1)
+    dense_size: int = 512
+    out_features: int = 1  # the reference's scalar-vision bottleneck
+    normalize_pixels: bool = False
+
+    @nn.compact
+    def __call__(self, frame: jax.Array) -> jax.Array:
+        x = frame.astype(jnp.float32)
+        if self.normalize_pixels:
+            x = x / 255.0
+        for i, (f, k, s) in enumerate(
+            zip(self.filters, self.kernel_sizes, self.strides)
+        ):
+            fan_in = int(np.prod((k, k, x.shape[-1])))
+            x = nn.Conv(
+                f,
+                kernel_size=(k, k),
+                strides=(s, s),
+                padding="VALID",
+                kernel_init=torch_linear_kernel_init,
+                bias_init=torch_linear_bias_init(fan_in),
+                name=f"conv_{i}",
+            )(x)
+            x = nn.relu(x)
+        x = x.reshape(x.shape[:-3] + (-1,))
+        x = Dense(self.dense_size)(x)
+        x = Dense(self.out_features)(x)
+        return x
+
+
+class VisualActor(nn.Module):
+    """Squashed-Gaussian policy over a :class:`MultiObservation`.
+
+    MLP trunk on ``features``, CNN embedding on ``frame``, concatenated
+    before the ``mu``/``log_std`` heads (ref ``convolutional.py:78-104``:
+    heads take ``hidden[-1] + cnn_features`` inputs). Unbatched inputs
+    are auto-batched and outputs squeezed, mirroring the reference's
+    reshape-and-squeeze behavior (ref ``convolutional.py:91-96,121``).
+    """
+
+    act_dim: int
+    hidden_sizes: t.Sequence[int] = (256, 256)
+    act_limit: float = 1.0
+    filters: t.Sequence[int] = (32, 64, 64)
+    kernel_sizes: t.Sequence[int] = (8, 4, 3)
+    strides: t.Sequence[int] = (4, 2, 1)
+    cnn_features: int = 1
+    normalize_pixels: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        obs: MultiObservation,
+        key: jax.Array | None = None,
+        deterministic: bool = False,
+        with_logprob: bool = True,
+    ):
+        features, frame = obs.features, obs.frame
+        unbatched = features.ndim == 1
+        if unbatched:
+            features = features[None]
+        if frame.ndim == 3:
+            frame = frame[None]
+
+        x = MLP(self.hidden_sizes, activate_final=True)(features)
+        vision = SimpleCNN(
+            self.filters,
+            self.kernel_sizes,
+            self.strides,
+            out_features=self.cnn_features,
+            normalize_pixels=self.normalize_pixels,
+            name="visual_network",
+        )(frame)
+        x = jnp.concatenate([x, vision], axis=-1)
+
+        mu = Dense(self.act_dim)(x)
+        log_std = Dense(self.act_dim)(x)
+        action, logprob = squashed_gaussian_sample(
+            key, mu, log_std, self.act_limit, deterministic, with_logprob
+        )
+        if unbatched:
+            action = jnp.squeeze(action, axis=0)
+            if logprob is not None:
+                logprob = jnp.squeeze(logprob, axis=0)
+        return action, logprob
+
+
+class VisualCritic(nn.Module):
+    """Q-network over a :class:`MultiObservation` and an action.
+
+    Parity quirk preserved: the feature/action MLP applies ReLU through
+    **every** layer including the final width-1 output (ref
+    ``convolutional.py:156-158`` loops activation over all layers), then
+    concatenates the CNN embedding and applies a final
+    ``Dense(1 + cnn_features -> 1)`` (ref ``convolutional.py:142,160-161``).
+    """
+
+    hidden_sizes: t.Sequence[int] = (256, 256)
+    filters: t.Sequence[int] = (32, 64, 64)
+    kernel_sizes: t.Sequence[int] = (8, 4, 3)
+    strides: t.Sequence[int] = (4, 2, 1)
+    cnn_features: int = 1
+    normalize_pixels: bool = False
+
+    @nn.compact
+    def __call__(self, obs: MultiObservation, action: jax.Array) -> jax.Array:
+        features, frame = obs.features, obs.frame
+        unbatched = features.ndim == 1
+        if unbatched:
+            features = features[None]
+            action = action[None]
+        if frame.ndim == 3:
+            frame = frame[None]
+
+        x = jnp.concatenate([features, action], axis=-1)
+        # ReLU after every layer, including the final width-1 layer
+        # (reference behavior, convolutional.py:156-158).
+        x = MLP(tuple(self.hidden_sizes) + (1,), activate_final=True)(x)
+        vision = SimpleCNN(
+            self.filters,
+            self.kernel_sizes,
+            self.strides,
+            out_features=self.cnn_features,
+            normalize_pixels=self.normalize_pixels,
+            name="visual_network",
+        )(frame)
+        x = jnp.concatenate([x, vision], axis=-1)
+        q = Dense(1, name="final")(x)
+        q = jnp.squeeze(q, axis=-1)
+        if unbatched:
+            q = jnp.squeeze(q, axis=0)
+        return q
+
+
+class VisualDoubleCritic(nn.Module):
+    """Vmapped ensemble of ``num_qs`` visual critics; returns ``(num_qs, ...)``.
+
+    Capability twin of the reference ``VisualDoubleCritic``
+    (ref ``convolutional.py:167-183``).
+    """
+
+    hidden_sizes: t.Sequence[int] = (256, 256)
+    filters: t.Sequence[int] = (32, 64, 64)
+    kernel_sizes: t.Sequence[int] = (8, 4, 3)
+    strides: t.Sequence[int] = (4, 2, 1)
+    cnn_features: int = 1
+    normalize_pixels: bool = False
+    num_qs: int = 2
+
+    @nn.compact
+    def __call__(self, obs: MultiObservation, action: jax.Array) -> jax.Array:
+        ensemble = nn.vmap(
+            VisualCritic,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=None,
+            out_axes=0,
+            axis_size=self.num_qs,
+        )
+        return ensemble(
+            self.hidden_sizes,
+            self.filters,
+            self.kernel_sizes,
+            self.strides,
+            self.cnn_features,
+            self.normalize_pixels,
+            name="ensemble",
+        )(obs, action)

@@ -1,0 +1,6 @@
+from torch_actor_critic_tpu.ops.distributions import (  # noqa: F401
+    gaussian_log_prob,
+    squashed_gaussian_sample,
+    tanh_log_prob_correction,
+)
+from torch_actor_critic_tpu.ops.polyak import polyak_update  # noqa: F401

@@ -1,0 +1,84 @@
+"""Squashed-Gaussian primitives.
+
+The exact math of the reference actor head (ref
+``networks/linear.py:39-51``): clip log-std to ``[-20, 2]``,
+reparameterized sample ``u = mu + sigma * eps``, squash
+``a = tanh(u) * act_limit``, and the numerically-stable tanh log-prob
+correction ``logp -= sum(2 * (log 2 - u - softplus(-2u)))`` (the
+log-derivative of tanh rewritten via softplus; same identity OpenAI
+spinningup uses). Kept as free functions so the MLP and CNN actors — and
+any future policy head — share one implementation instead of the
+reference's copy in each module (ref ``networks/convolutional.py:105-120``).
+
+Note the reference (and spinningup) do *not* include the ``act_limit``
+scale in the log-prob correction; we reproduce that behavior exactly for
+parity (``act_limit`` is 1.0 for all standard MuJoCo envs, so the
+constant only matters for the reference's nonstandard default of 10,
+ref ``networks/linear.py:22``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+LOG_STD_MIN = -20.0
+LOG_STD_MAX = 2.0
+
+_LOG_SQRT_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+def gaussian_log_prob(u: jax.Array, mu: jax.Array, log_std: jax.Array) -> jax.Array:
+    """Diagonal-Gaussian log-density, summed over the trailing axis.
+
+    Matches ``Normal(mu, std).log_prob(u).sum(-1)``
+    (ref ``networks/linear.py:50``).
+    """
+    std = jnp.exp(log_std)
+    z = (u - mu) / std
+    return jnp.sum(-0.5 * z * z - log_std - _LOG_SQRT_2PI, axis=-1)
+
+
+def tanh_log_prob_correction(u: jax.Array) -> jax.Array:
+    """``sum(2 * (log 2 - u - softplus(-2u)))`` over the trailing axis.
+
+    The stable form of ``sum(log(1 - tanh(u)^2))``
+    (ref ``networks/linear.py:51``).
+    """
+    return jnp.sum(2.0 * (math.log(2.0) - u - jax.nn.softplus(-2.0 * u)), axis=-1)
+
+
+def squashed_gaussian_sample(
+    key: jax.Array | None,
+    mu: jax.Array,
+    log_std: jax.Array,
+    act_limit: float,
+    deterministic: bool = False,
+    with_logprob: bool = True,
+):
+    """Sample (or take the mode of) a tanh-squashed Gaussian policy.
+
+    Returns ``(action, log_prob)``; ``log_prob`` is ``None`` when
+    ``with_logprob`` is False. ``deterministic``/``with_logprob`` mirror
+    the reference forward flags (ref ``networks/linear.py:32,43-51``).
+    Pure function of an explicit PRNG ``key`` — the TPU-native
+    replacement for torch's global-RNG ``rsample()``.
+    """
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    if deterministic:
+        u = mu
+    else:
+        if key is None:
+            raise ValueError(
+                "squashed_gaussian_sample: a PRNG `key` is required when "
+                "deterministic=False (stochastic sampling)."
+            )
+        u = mu + jnp.exp(log_std) * jax.random.normal(key, mu.shape, mu.dtype)
+    action = jnp.tanh(u) * act_limit
+
+    logprob = None
+    if with_logprob:
+        logprob = gaussian_log_prob(u, mu, log_std) - tanh_log_prob_correction(u)
+    return action, logprob

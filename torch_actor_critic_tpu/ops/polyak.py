@@ -1,0 +1,22 @@
+"""Polyak (exponential moving average) target-network update.
+
+Functional equivalent of the reference's in-place
+``targ = polyak * targ + (1 - polyak) * src`` loop over parameters
+(ref ``sac/algorithm.py:77-81``). One ``tree_map``; XLA fuses it into
+the surrounding update step so the whole thing is a single multiply-add
+over each parameter buffer — no per-tensor Python loop, no ``no_grad``
+bookkeeping.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+
+
+def polyak_update(source: t.Any, target: t.Any, polyak: float) -> t.Any:
+    """Return ``polyak * target + (1 - polyak) * source``, leaf-wise."""
+    return jax.tree_util.tree_map(
+        lambda s, tgt: polyak * tgt + (1.0 - polyak) * s, source, target
+    )
