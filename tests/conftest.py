@@ -11,14 +11,27 @@ Must set env vars before jax is imported anywhere.
 """
 
 import os
+import re
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# The suite assumes exactly 8 virtual devices; strip any externally-set
+# device-count flag rather than half-honoring it and failing later.
+_flags = re.sub(
+    r"--xla_force_host_platform_device_count=\d+",
+    "",
+    os.environ.get("XLA_FLAGS", ""),
+)
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=8"
+).strip()
 
 import jax  # noqa: E402
 
+# The axon sitecustomize hook re-registers "axon,cpu" over the env var;
+# force CPU again post-import or tests silently run on the tunneled TPU
+# (whose fp32 matmuls go through bf16 passes — parity tests would see
+# ~1e-3 error).
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+assert jax.device_count() == 8, jax.devices()

@@ -1,0 +1,110 @@
+"""Ring/sampling invariants for the device replay buffer.
+
+The reference never tests its buffers (SURVEY.md §4 "Not tested");
+these pin down the ring protocol the reference implements in
+``buffer/replay_buffer.py:29-46``: pointer wraparound, size saturation,
+oldest-overwrite, and sampling restricted to the valid region.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torch_actor_critic_tpu.buffer import (
+    init_replay_buffer,
+    init_visual_replay_buffer,
+    push,
+    sample,
+)
+from torch_actor_critic_tpu.core.types import Batch
+
+OBS_DIM, ACT_DIM, CAP = 4, 2, 10
+
+
+def _chunk(start: int, n: int) -> Batch:
+    """n transitions whose reward encodes their global index."""
+    r = jnp.arange(start, start + n, dtype=jnp.float32)
+    return Batch(
+        states=jnp.tile(r[:, None], (1, OBS_DIM)),
+        actions=jnp.zeros((n, ACT_DIM)),
+        rewards=r,
+        next_states=jnp.tile(r[:, None] + 0.5, (1, OBS_DIM)),
+        done=jnp.zeros((n,)),
+    )
+
+
+def test_push_advances_ptr_and_size():
+    buf = init_replay_buffer(CAP, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32), ACT_DIM)
+    buf = push(buf, _chunk(0, 3))
+    assert int(buf.ptr) == 3 and int(buf.size) == 3
+    buf = push(buf, _chunk(3, 4))
+    assert int(buf.ptr) == 7 and int(buf.size) == 7
+
+
+def test_push_wraparound_overwrites_oldest():
+    buf = init_replay_buffer(CAP, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32), ACT_DIM)
+    buf = push(buf, _chunk(0, 8))
+    buf = push(buf, _chunk(8, 6))  # wraps: slots 8,9,0,1,2,3
+    assert int(buf.ptr) == 4
+    assert int(buf.size) == CAP
+    rewards = np.asarray(buf.data.rewards)
+    # slots 0..3 hold transitions 10..13; slots 4..7 hold 4..7; 8,9 hold 8,9
+    np.testing.assert_array_equal(rewards, [10, 11, 12, 13, 4, 5, 6, 7, 8, 9])
+
+
+def test_sample_only_valid_region():
+    buf = init_replay_buffer(CAP, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32), ACT_DIM)
+    buf = push(buf, _chunk(0, 3))  # only rewards 0,1,2 valid
+    batch = sample(buf, jax.random.key(0), 256)
+    assert set(np.asarray(batch.rewards).tolist()) <= {0.0, 1.0, 2.0}
+    # states/next_states must be gathered consistently with rewards
+    np.testing.assert_array_equal(
+        np.asarray(batch.states)[:, 0], np.asarray(batch.rewards)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(batch.next_states)[:, 0], np.asarray(batch.rewards) + 0.5
+    )
+
+
+def test_sample_covers_full_buffer():
+    buf = init_replay_buffer(CAP, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32), ACT_DIM)
+    buf = push(buf, _chunk(0, CAP))
+    batch = sample(buf, jax.random.key(1), 1024)
+    seen = set(np.asarray(batch.rewards).tolist())
+    assert seen == set(float(i) for i in range(CAP))
+
+
+def test_push_sample_jit_and_donate():
+    """push must jit with buffer donation (the trainer's hot path)."""
+    buf = init_replay_buffer(CAP, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32), ACT_DIM)
+    push_jit = jax.jit(push, donate_argnums=(0,))
+    buf = push_jit(buf, _chunk(0, 4))
+    buf = push_jit(buf, _chunk(4, 4))
+    assert int(buf.size) == 8
+    batch = jax.jit(sample, static_argnums=(2,))(buf, jax.random.key(0), 16)
+    assert batch.rewards.shape == (16,)
+
+
+def test_visual_buffer_uint8_roundtrip():
+    from torch_actor_critic_tpu.core.types import MultiObservation
+
+    buf = init_visual_replay_buffer(CAP, feature_dim=3, frame_shape=(8, 8, 3), act_dim=2)
+    assert buf.data.states.frame.dtype == jnp.uint8
+
+    n = 4
+    obs = MultiObservation(
+        features=jnp.ones((n, 3)),
+        frame=jnp.full((n, 8, 8, 3), 200, jnp.uint8),
+    )
+    chunk = Batch(
+        states=obs,
+        actions=jnp.zeros((n, 2)),
+        rewards=jnp.arange(n, dtype=jnp.float32),
+        next_states=obs,
+        done=jnp.zeros((n,)),
+    )
+    buf = push(buf, chunk)
+    batch = sample(buf, jax.random.key(0), 8)
+    assert batch.states.frame.dtype == jnp.uint8
+    assert int(batch.states.frame[0, 0, 0, 0]) == 200
+    assert batch.states.features.shape == (8, 3)

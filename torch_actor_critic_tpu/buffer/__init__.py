@@ -1,0 +1,6 @@
+from torch_actor_critic_tpu.buffer.replay import (  # noqa: F401
+    init_replay_buffer,
+    init_visual_replay_buffer,
+    push,
+    sample,
+)

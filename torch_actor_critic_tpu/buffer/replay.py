@@ -1,0 +1,146 @@
+"""HBM-resident uniform-sampling ring replay buffer.
+
+Capability twin of the reference's host-side NumPy ring buffers
+(``ReplayBuffer``, ref ``buffer/replay_buffer.py:17-54``, and
+``VisualReplayBuffer``, ref ``buffer/visual_replay_buffer.py:21-66``),
+re-designed for TPU:
+
+- **Device-resident**: the ring lives in HBM as preallocated
+  ``jax.Array`` leaves of a :class:`~torch_actor_critic_tpu.core.types.BufferState`;
+  ``push``/``sample`` are pure jittable functions, so sampling happens
+  *inside* the fused SAC update step with zero host<->device traffic per
+  gradient step (the reference converts NumPy->torch on every sample,
+  ref ``replay_buffer.py:47-54``).
+- **One generic implementation**: observations are pytrees, so the
+  visual buffer is the same code over ``MultiObservation`` leaves —
+  no subclass that overrides everything (ref
+  ``visual_replay_buffer.py:21``: "subclasses ReplayBuffer but
+  overrides everything"). Frames are stored **uint8** (4x less HBM than
+  the reference's float object-arrays; 1e6 64x64x3 frames = 12 GB fp32
+  vs 3 GB u8) and cast to float inside the model.
+- **Chunked stores**: the host env loop accumulates ``update_every``
+  transitions and pushes them in one call (one dispatch per burst
+  instead of the reference's per-step ``store``,
+  ref ``sac/algorithm.py:249``). Wraparound handled with modular
+  scatter indices — compiler-friendly, no data-dependent shapes.
+- **Sampling is uniform with replacement** (``randint`` + ``take``).
+  The reference samples *without* replacement via ``random.sample``
+  (ref ``replay_buffer.py:46``); at 1e6-slot buffers and batch 64 the
+  collision probability per batch is ~2e-3, a deliberate,
+  XLA-friendly deviation (SURVEY.md §7 item 3). Before the buffer is
+  full, indices are drawn over ``[0, size)`` exactly like the
+  reference's ``range(self.size)``.
+
+Donation: callers should jit ``push`` with ``donate_argnums=(0,)`` (the
+trainer does) so XLA updates the ring in place instead of copying the
+full 1e6-slot arrays per store.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+
+from torch_actor_critic_tpu.core.types import Batch, BufferState, MultiObservation
+
+
+def _zeros_like_spec(capacity: int, spec: t.Any) -> t.Any:
+    """Build zeroed ring arrays from a pytree of (shape, dtype) specs.
+
+    A spec leaf is anything with ``.shape`` and ``.dtype`` (e.g. a
+    ``jax.ShapeDtypeStruct`` or a concrete example array).
+    """
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros((capacity,) + tuple(s.shape), s.dtype), spec
+    )
+
+
+def init_replay_buffer(
+    capacity: int,
+    obs_spec: t.Any,
+    act_dim: int,
+    act_dtype=jnp.float32,
+) -> BufferState:
+    """Preallocate an empty ring buffer.
+
+    ``obs_spec`` is a pytree of ``jax.ShapeDtypeStruct`` (or example
+    arrays) describing ONE observation — a flat vector for MLP envs
+    (ref ``replay_buffer.py:19-23``) or a ``MultiObservation`` spec for
+    pixel envs.
+    """
+    data = Batch(
+        states=_zeros_like_spec(capacity, obs_spec),
+        actions=jnp.zeros((capacity, act_dim), act_dtype),
+        rewards=jnp.zeros((capacity,), jnp.float32),
+        next_states=_zeros_like_spec(capacity, obs_spec),
+        done=jnp.zeros((capacity,), jnp.float32),
+    )
+    return BufferState(data=data, ptr=jnp.int32(0), size=jnp.int32(0))
+
+
+def init_visual_replay_buffer(
+    capacity: int,
+    feature_dim: int,
+    frame_shape: t.Tuple[int, int, int],
+    act_dim: int,
+) -> BufferState:
+    """Convenience constructor for the mixed-observation buffer.
+
+    Counterpart of the reference ``VisualReplayBuffer`` constructor
+    (ref ``visual_replay_buffer.py:22-31``) with uint8 HWC frames.
+    """
+    obs_spec = MultiObservation(
+        features=jax.ShapeDtypeStruct((feature_dim,), jnp.float32),
+        frame=jax.ShapeDtypeStruct(tuple(frame_shape), jnp.uint8),
+    )
+    return init_replay_buffer(capacity, obs_spec, act_dim)
+
+
+def push(state: BufferState, chunk: Batch) -> BufferState:
+    """Append a chunk of ``n`` transitions, overwriting oldest on wrap.
+
+    Equivalent of ``n`` reference ``store`` calls
+    (ref ``replay_buffer.py:29-43``): writes at
+    ``(ptr + arange(n)) % capacity``, then advances ``ptr`` and
+    saturates ``size`` at capacity. ``n`` must be static (it is: the
+    trainer always pushes ``update_every``-sized chunks).
+    """
+    capacity = state.capacity
+    n = jax.tree_util.tree_leaves(chunk)[0].shape[0]
+    if n > capacity:
+        # Duplicate scatter indices would overwrite in unspecified order.
+        raise ValueError(
+            f"push: chunk of {n} transitions exceeds buffer capacity "
+            f"{capacity}; use a larger buffer or smaller chunks."
+        )
+    idx = (state.ptr + jnp.arange(n)) % capacity
+
+    data = jax.tree_util.tree_map(
+        lambda ring, new: ring.at[idx].set(new), state.data, chunk
+    )
+    return BufferState(
+        data=data,
+        ptr=(state.ptr + n) % capacity,
+        size=jnp.minimum(state.size + n, capacity),
+    )
+
+
+def sample(state: BufferState, key: jax.Array, batch_size: int) -> Batch:
+    """Draw a uniform batch over the valid region ``[0, size)``.
+
+    With replacement (deliberate deviation from ref
+    ``replay_buffer.py:46``, see module docstring). Gathers are plain
+    ``jnp.take`` so XLA lowers them to efficient dynamic-gathers; a
+    Pallas gather path can slot in here if profiles demand it.
+
+    An empty buffer raises eagerly; under ``jit`` the size is traced and
+    cannot be checked, so the index range is clamped to ``[0, 1)`` —
+    callers must gate on ``size > 0`` (the trainer's ``update_after``
+    warmup guarantees this, ref ``sac/algorithm.py:273``).
+    """
+    if not isinstance(state.size, jax.core.Tracer) and int(state.size) == 0:
+        raise ValueError("sample: replay buffer is empty (size == 0).")
+    idx = jax.random.randint(key, (batch_size,), 0, jnp.maximum(state.size, 1))
+    return jax.tree_util.tree_map(lambda ring: jnp.take(ring, idx, axis=0), state.data)

@@ -1,0 +1,2 @@
+from torch_actor_critic_tpu.sac.losses import actor_loss, alpha_loss, critic_loss  # noqa: F401
+from torch_actor_critic_tpu.sac.algorithm import SAC  # noqa: F401

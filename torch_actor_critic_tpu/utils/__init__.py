@@ -1,0 +1,1 @@
+from torch_actor_critic_tpu.utils.config import SACConfig  # noqa: F401

@@ -1,0 +1,78 @@
+"""Typed configuration.
+
+Replaces the reference's hardcoded hyperparameter dict
+(ref ``main.py:147-160``) and scattered constants (lr ``main.py:93``,
+buffer size ``main.py:140``, hidden sizes ``main.py:61``) with one
+dataclass that round-trips through JSON for checkpoint/resume — the
+reference round-trips params through MLflow *strings* and re-parses
+them with ``int(float(v))`` heuristics (ref ``main.py:46-50``).
+
+Defaults reproduce the reference run configuration exactly
+(BASELINE.md "Reference run config").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing as t
+
+
+@dataclasses.dataclass
+class SACConfig:
+    # --- SAC hyperparameters (ref main.py:147-160) ---
+    alpha: float = 0.2  # fixed entropy temperature (ref main.py:148)
+    gamma: float = 0.99
+    polyak: float = 0.995
+    reward_scale: float = 1.0
+    epochs: int = 1000
+    batch_size: int = 64
+    steps_per_epoch: int = 5000
+    start_steps: int = 1000
+    update_after: int = 1000
+    update_every: int = 50
+    max_ep_len: int = 5000
+    save_every: int = 10
+
+    # --- model / optimizer (ref main.py:61,93,140) ---
+    lr: float = 3e-4
+    hidden_sizes: t.Tuple[int, ...] = (256, 256)
+    buffer_size: int = 1_000_000
+    num_qs: int = 2  # ensemble size; 2 == reference DoubleCritic
+
+    # --- extensions beyond the reference capability envelope ---
+    # Learned entropy temperature (SAC v2). The reference fixes
+    # alpha=0.2; learn_alpha=False is parity mode.
+    learn_alpha: bool = False
+    target_entropy: t.Optional[float] = None  # default: -act_dim
+
+    # Reference-quirk switch (SURVEY.md §7 item 4): the reference
+    # samples pi from `next_state` but evaluates Q at `state` in the
+    # policy loss (ref sac/algorithm.py:37-38). False (default) uses
+    # `state` for both, matching spinningup; True reproduces the
+    # reference exactly for return-parity runs.
+    parity_pi_obs: bool = False
+
+    # Visual stack (ref main.py:63-90)
+    cnn_features: int = 1  # 1 == reference scalar-vision bottleneck
+    normalize_pixels: bool = False
+
+    # Observation normalization (the reference ships a Welford
+    # normalizer as dead code, ref sac/utils.py:27-65; here it's a
+    # usable option).
+    normalize_observations: bool = False
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "SACConfig":
+        raw = json.loads(s)
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in raw.items() if k in field_names}
+        if "hidden_sizes" in kwargs:
+            kwargs["hidden_sizes"] = tuple(kwargs["hidden_sizes"])
+        return cls(**kwargs)
+
+    def replace(self, **kwargs) -> "SACConfig":
+        return dataclasses.replace(self, **kwargs)
