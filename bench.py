@@ -1,0 +1,185 @@
+"""Headline benchmark: SAC gradient-steps/sec on one TPU chip.
+
+BASELINE.md: the reference publishes no numbers, so the measured
+baseline is a PyTorch-CPU implementation of the same update at the
+reference run configuration (alpha=0.2 fixed, gamma=0.99, polyak=0.995,
+batch 64, hidden [256,256], lr 3e-4, ``torch.set_num_threads(2)`` as in
+ref ``main.py:130``) on HalfCheetah-v3 dimensions (obs 17, act 6).
+
+Prints ONE JSON line:
+    {"metric": "sac_grad_steps_per_sec", "value": N, "unit":
+     "steps/sec", "vs_baseline": ratio_vs_torch_cpu}
+
+The TPU number is measured through the real training path — the fused
+``update_burst`` (push + 50 sampled gradient steps per dispatch) over
+the HBM replay buffer, exactly what the trainer runs.
+"""
+
+import json
+import time
+
+import numpy as np
+
+OBS_DIM, ACT_DIM = 17, 6
+BATCH = 64
+HIDDEN = (256, 256)
+BURST = 50
+
+
+def bench_tpu() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from torch_actor_critic_tpu.buffer import init_replay_buffer, push
+    from torch_actor_critic_tpu.core.types import Batch
+    from torch_actor_critic_tpu.models import Actor, DoubleCritic
+    from torch_actor_critic_tpu.sac import SAC
+    from torch_actor_critic_tpu.utils.config import SACConfig
+
+    cfg = SACConfig(batch_size=BATCH, hidden_sizes=HIDDEN)
+    sac = SAC(cfg, Actor(act_dim=ACT_DIM, hidden_sizes=HIDDEN), DoubleCritic(hidden_sizes=HIDDEN), ACT_DIM)
+    state = sac.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
+    buf = init_replay_buffer(
+        1_000_000, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32), ACT_DIM
+    )
+
+    def chunk(key, n=BURST):
+        ks = jax.random.split(jax.random.key(key), 5)
+        return Batch(
+            states=jax.random.normal(ks[0], (n, OBS_DIM)),
+            actions=jnp.tanh(jax.random.normal(ks[1], (n, ACT_DIM))),
+            rewards=jax.random.normal(ks[2], (n,)),
+            next_states=jax.random.normal(ks[3], (n, OBS_DIM)),
+            done=jnp.zeros((n,)),
+        )
+
+    buf = jax.jit(push, donate_argnums=(0,))(buf, chunk(1, 5000))
+    burst = jax.jit(sac.update_burst, static_argnums=(3,), donate_argnums=(0, 1))
+
+    # compile + warmup
+    state, buf, m = burst(state, buf, chunk(2), BURST)
+    jax.block_until_ready(m)
+
+    n_bursts = 60
+    t0 = time.perf_counter()
+    for i in range(n_bursts):
+        state, buf, m = burst(state, buf, chunk(10 + i), BURST)
+    jax.block_until_ready(m)
+    dt = time.perf_counter() - t0
+    return n_bursts * BURST / dt
+
+
+def bench_torch_cpu() -> float:
+    """Reference-style torch-CPU SAC update (independent implementation
+    of the same math: twin-critic Bellman MSE + squashed-Gaussian policy
+    loss + polyak), timed per gradient step incl. uniform replay
+    sampling — the measured stand-in for the unpublished reference
+    baseline."""
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    torch.set_num_threads(2)  # ref main.py:130
+
+    def mlp(sizes, out_dim):
+        layers, prev = [], sizes[0]
+        for h in sizes[1:]:
+            layers += [nn.Linear(prev, h), nn.ReLU()]
+            prev = h
+        layers.append(nn.Linear(prev, out_dim))
+        return nn.Sequential(*layers)
+
+    class TorchActor(nn.Module):
+        def __init__(self):
+            super().__init__()
+            # Linear(17,256)+ReLU+Linear(256,256); forward adds the
+            # second ReLU — a 2-hidden trunk matching the JAX Actor.
+            self.trunk = mlp([OBS_DIM, HIDDEN[0]], HIDDEN[1])
+            self.mu = nn.Linear(HIDDEN[-1], ACT_DIM)
+            self.log_std = nn.Linear(HIDDEN[-1], ACT_DIM)
+
+        def forward(self, obs):
+            h = F.relu(self.trunk(obs))
+            mu, log_std = self.mu(h), torch.clip(self.log_std(h), -20, 2)
+            std = torch.exp(log_std)
+            u = mu + std * torch.randn_like(mu)
+            a = torch.tanh(u)
+            logp = torch.distributions.Normal(mu, std).log_prob(u).sum(-1)
+            logp = logp - (2 * (np.log(2) - u - F.softplus(-2 * u))).sum(-1)
+            return a, logp
+
+    actor = TorchActor()
+    critics = [mlp([OBS_DIM + ACT_DIM, *HIDDEN], 1) for _ in range(2)]
+    targets = [mlp([OBS_DIM + ACT_DIM, *HIDDEN], 1) for _ in range(2)]
+    for c, t in zip(critics, targets):
+        t.load_state_dict(c.state_dict())
+    pi_opt = torch.optim.Adam(actor.parameters(), lr=3e-4)
+    q_opt = torch.optim.Adam(
+        [p for c in critics for p in c.parameters()], lr=3e-4
+    )
+
+    n = 100_000
+    data = {
+        "s": torch.randn(n, OBS_DIM),
+        "a": torch.tanh(torch.randn(n, ACT_DIM)),
+        "r": torch.randn(n),
+        "s2": torch.randn(n, OBS_DIM),
+        "d": torch.zeros(n),
+    }
+
+    def q_of(nets, s, a):
+        x = torch.cat([s, a], -1)
+        return [net(x).squeeze(-1) for net in nets]
+
+    def step():
+        idx = torch.randint(0, n, (BATCH,))
+        s, a, r, s2, d = (data[k][idx] for k in ("s", "a", "r", "s2", "d"))
+        with torch.no_grad():
+            a2, logp2 = actor(s2)
+            q_t = torch.min(*q_of(targets, s2, a2))
+            backup = r + 0.99 * (1 - d) * (q_t - 0.2 * logp2)
+        q1, q2 = q_of(critics, s, a)
+        loss_q = ((q1 - backup) ** 2).mean() + ((q2 - backup) ** 2).mean()
+        q_opt.zero_grad(); loss_q.backward(); q_opt.step()
+
+        for c in critics:
+            for p in c.parameters():
+                p.requires_grad_(False)
+        pi, logp = actor(s)
+        loss_pi = (0.2 * logp - torch.min(*q_of(critics, s, pi))).mean()
+        pi_opt.zero_grad(); loss_pi.backward(); pi_opt.step()
+        for c in critics:
+            for p in c.parameters():
+                p.requires_grad_(True)
+
+        with torch.no_grad():
+            for c, t in zip(critics, targets):
+                for pc, pt in zip(c.parameters(), t.parameters()):
+                    pt.mul_(0.995).add_(0.005 * pc)
+
+    for _ in range(20):  # warmup
+        step()
+    n_steps = 300
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        step()
+    return n_steps / (time.perf_counter() - t0)
+
+
+def main():
+    torch_sps = bench_torch_cpu()
+    tpu_sps = bench_tpu()
+    print(
+        json.dumps(
+            {
+                "metric": "sac_grad_steps_per_sec",
+                "value": round(tpu_sps, 1),
+                "unit": "steps/sec",
+                "vs_baseline": round(tpu_sps / torch_sps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

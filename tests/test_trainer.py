@@ -1,0 +1,181 @@
+"""End-to-end trainer, checkpoint/resume, tracking and CLI tests.
+
+Covers the layer the reference leaves untested (train loop,
+checkpointing — SURVEY.md §4 "Not tested") with a tiny Pendulum-v1
+config on a 2-device slice of the CPU mesh.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from torch_actor_critic_tpu.parallel import make_mesh
+from torch_actor_critic_tpu.sac.trainer import Trainer
+from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
+from torch_actor_critic_tpu.utils.config import SACConfig
+from torch_actor_critic_tpu.utils.tracking import Tracker
+
+TINY = dict(
+    hidden_sizes=(32, 32),
+    batch_size=32,
+    epochs=2,
+    steps_per_epoch=60,
+    start_steps=20,
+    update_after=20,
+    update_every=10,
+    buffer_size=2000,
+    max_ep_len=200,
+)
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    root = tmp_path_factory.mktemp("runs")
+    cfg = SACConfig(**TINY)
+    tracker = Tracker(experiment="test", root=root)
+    ckpt = Checkpointer(tracker.artifact_path("checkpoints"))
+    tr = Trainer(
+        "Pendulum-v1", cfg, mesh=make_mesh(dp=2), tracker=tracker, checkpointer=ckpt
+    )
+    metrics = tr.train()
+    return tr, tracker, metrics, root
+
+
+def test_training_progresses(trained):
+    tr, _, metrics, _ = trained
+    assert int(tr.state.step) == 100  # 10 update windows x 10 steps
+    np.testing.assert_array_equal(np.asarray(tr.buffer.size), [120, 120])
+    for k in ("episode_length", "reward", "loss_q", "loss_pi"):
+        assert k in metrics  # reference metric names (algorithm.py:285-290)
+    assert np.isfinite(metrics["loss_q"])
+
+
+def test_tracker_wrote_metrics_and_params(trained):
+    _, tracker, _, _ = trained
+    rows = tracker.metrics()
+    assert len(rows) == 2  # one per epoch
+    assert "loss_q" in rows[0]
+
+
+def test_evaluate(trained):
+    tr, _, _, _ = trained
+    ev = tr.evaluate(episodes=2, deterministic=True)
+    assert ev["ep_len_mean"] == 200.0  # Pendulum never terminates early
+    assert np.isfinite(ev["ep_ret_mean"])
+
+
+def test_checkpoint_resume_full_state(trained):
+    tr, tracker, _, root = trained
+    ckpt2 = Checkpointer(tracker.artifact_path("checkpoints"))
+    cfg = SACConfig(**TINY)
+    tr2 = Trainer("Pendulum-v1", cfg, mesh=make_mesh(dp=2), checkpointer=ckpt2)
+    start = tr2.restore()
+    assert start == 1  # saved at epoch 0 (e % save_every == 0 for e=0)
+    # Full state round-trips: a real (non-init) step counter, params
+    # distinct from fresh init, and a non-empty restored buffer —
+    # everything the reference's load_session loses (SURVEY.md §3.5).
+    assert 0 < int(tr2.state.step) <= int(tr.state.step)
+    fresh = Trainer("Pendulum-v1", cfg, mesh=make_mesh(dp=2))
+    a = jax.tree_util.tree_leaves(tr2.state.actor_params)[0]
+    b = jax.tree_util.tree_leaves(fresh.state.actor_params)[0]
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+    assert int(tr2.buffer.size[0]) > 0  # buffer restored, not empty
+
+
+def test_weights_only_restore(trained):
+    tr, tracker, _, _ = trained
+    ckpt = Checkpointer(tracker.artifact_path("checkpoints"))
+    cfg = SACConfig(**TINY)
+    tr2 = Trainer("Pendulum-v1", cfg, mesh=make_mesh(dp=1), checkpointer=ckpt)
+    tr2.restore(include_buffer=False)
+    assert int(tr2.buffer.size[0]) == 0  # untouched
+
+
+def test_train_cli_smoke(tmp_path):
+    from torch_actor_critic_tpu.train import main
+
+    metrics = main(
+        [
+            "--environment",
+            "Pendulum-v1",
+            "--devices",
+            "1",
+            "--runs-root",
+            str(tmp_path),
+            "--epochs",
+            "1",
+            "--steps-per-epoch",
+            "40",
+            "--start-steps",
+            "10",
+            "--update-after",
+            "10",
+            "--update-every",
+            "10",
+            "--batch-size",
+            "16",
+            "--buffer-size",
+            "500",
+            "--hidden-sizes",
+            "16,16",
+            "--max-ep-len",
+            "100",
+        ]
+    )
+    assert "loss_q" in metrics
+    # run directory with params + metrics + checkpoint exists
+    exp_dir = tmp_path / "Default"
+    run_dirs = list(exp_dir.iterdir())
+    assert len(run_dirs) == 1
+    params = json.loads((run_dirs[0] / "params.json").read_text())
+    assert params["environment"] == "Pendulum-v1"
+    assert params["config"]["batch_size"] == 16
+
+
+def test_run_agent_cli_smoke(tmp_path):
+    from torch_actor_critic_tpu.run_agent import main as eval_main
+    from torch_actor_critic_tpu.train import main as train_main
+
+    train_main(
+        [
+            "--environment",
+            "Pendulum-v1",
+            "--devices",
+            "1",
+            "--runs-root",
+            str(tmp_path),
+            "--epochs",
+            "1",
+            "--steps-per-epoch",
+            "30",
+            "--start-steps",
+            "10",
+            "--update-after",
+            "10",
+            "--update-every",
+            "10",
+            "--batch-size",
+            "16",
+            "--buffer-size",
+            "500",
+            "--hidden-sizes",
+            "16,16",
+            "--max-ep-len",
+            "100",
+        ]
+    )
+    run_id = next((tmp_path / "Default").iterdir()).name
+    metrics = eval_main(
+        [
+            "--run",
+            run_id,
+            "--runs-root",
+            str(tmp_path),
+            "--episodes",
+            "1",
+            "--headless",
+        ]
+    )
+    assert np.isfinite(metrics["ep_ret_mean"])

@@ -1,0 +1,19 @@
+"""Environment registry.
+
+The reference registers one custom env id with gym
+(``DeepMindWallRunner-v0``, ref ``environments/__init__.py:4-7``) and
+otherwise defers to ``gym.make`` (ref ``main.py:167``). Here
+:func:`make_env` is the single entry point, dispatching on name:
+
+- ``"DeepMindWallRunner-v0"`` -> the dm_control wall-runner port
+  (:mod:`torch_actor_critic_tpu.envs.wall_runner`),
+- ``"dm:<domain>:<task>"`` -> any dm_control suite task via the generic
+  wrapper (covers BASELINE.md config 3, dm_control cheetah-run),
+- anything else -> gymnasium (``Pendulum-v1``, ``HalfCheetah-v5``, ...).
+"""
+
+from torch_actor_critic_tpu.envs.wrappers import (  # noqa: F401
+    DmControlEnv,
+    GymnasiumEnv,
+    make_env,
+)

@@ -1,0 +1,94 @@
+"""dm_control CMU-humanoid wall-runner task with egocentric vision.
+
+Behavioral twin of the reference's ``DeepMindWallRunner`` gym env
+(ref ``environments/wall_runner.py:17-62``): wraps
+``basic_cmu_2019.cmu_humanoid_run_walls()``, concatenates the same 12
+named walker sensor arrays into a 168-dim feature vector (ref
+``:38-52``), and pairs it with the 64x64 egocentric camera frame as a
+:class:`~torch_actor_critic_tpu.core.types.MultiObservation`.
+
+TPU-native deviation: the frame stays **HWC uint8** (the camera's
+native format) instead of the reference's CHW float roll (ref ``:54``)
+— NHWC is XLA:TPU's conv layout and uint8 is what the replay buffer
+stores. Action space is 56-dim in [-1, 1] (ref ``:20``).
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torch_actor_critic_tpu.core.types import MultiObservation
+
+# The 12 sensor groups, in reference order (ref wall_runner.py:38-52).
+SENSOR_KEYS = (
+    "walker/appendages_pos",
+    "walker/body_height",
+    "walker/end_effectors_pos",
+    "walker/joints_pos",
+    "walker/joints_vel",
+    "walker/sensors_accelerometer",
+    "walker/sensors_force",
+    "walker/sensors_gyro",
+    "walker/sensors_torque",
+    "walker/sensors_touch",
+    "walker/sensors_velocimeter",
+    "walker/world_zaxis",
+)
+
+FEATURE_DIM = 168
+FRAME_SHAPE = (64, 64, 3)  # HWC uint8
+ACT_DIM = 56
+
+
+class DeepMindWallRunner:
+    """Humanoid wall-running with mixed proprioceptive+pixel obs."""
+
+    name = "DeepMindWallRunner-v0"
+
+    def __init__(self, seed: int | None = None):
+        from dm_control.locomotion.examples import basic_cmu_2019
+
+        self.env = basic_cmu_2019.cmu_humanoid_run_walls(random_state=seed)
+        self.act_dim = ACT_DIM
+        self.act_limit = 1.0
+        self._rng = np.random.default_rng(seed)
+        self.obs_spec = MultiObservation(
+            features=jax.ShapeDtypeStruct((FEATURE_DIM,), jnp.float32),
+            frame=jax.ShapeDtypeStruct(FRAME_SHAPE, jnp.uint8),
+        )
+
+    def _process(self, obs: t.Mapping[str, np.ndarray]) -> MultiObservation:
+        """12-sensor concat + camera passthrough (ref ``:38-59``).
+
+        ``body_height`` is a scalar; ``atleast_1d`` plays the role of the
+        reference's ``[np.newaxis, ...]`` (ref ``:40``).
+        """
+        features = np.concatenate(
+            [np.atleast_1d(np.asarray(obs[k], np.float32)).ravel() for k in SENSOR_KEYS]
+        )
+        frame = np.asarray(obs["walker/egocentric_camera"], np.uint8)
+        return MultiObservation(features=features, frame=frame)
+
+    def reset(self, seed: int | None = None) -> MultiObservation:
+        ts = self.env.reset()
+        return self._process(ts.observation)
+
+    def step(self, action: np.ndarray):
+        ts = self.env.step(np.asarray(action))
+        terminated = bool(ts.last() and ts.discount == 0.0)
+        truncated = bool(ts.last() and not terminated)
+        return self._process(ts.observation), float(ts.reward or 0.0), terminated, truncated
+
+    def sample_action(self) -> np.ndarray:
+        return self._rng.uniform(-1.0, 1.0, ACT_DIM).astype(np.float32)
+
+    def render(self):
+        """No-op, like the reference (ref ``wall_runner.py:61-62``)."""
+        pass
+
+    def close(self):
+        pass

@@ -1,0 +1,133 @@
+"""Host-side environment adapters.
+
+Physics stays on the host (MuJoCo/dm_control are C libraries; SURVEY.md
+§7 hard-part (e)); these adapters normalize every env family to one
+small protocol the trainer consumes:
+
+- ``reset(seed) -> obs``
+- ``step(action) -> (obs, reward, terminated, truncated)``
+- ``obs_spec`` (pytree of ShapeDtypeStruct), ``act_dim``, ``act_limit``
+- ``sample_action()`` uniform random action (the reference's
+  ``env.action_space.sample()`` warmup, ref ``sac/algorithm.py:228``)
+
+The reference targets the legacy gym API (4-tuple ``step``, ref
+``sac/algorithm.py:238``); this environment ships gymnasium, whose
+5-tuple split of ``terminated``/``truncated`` we keep — it is the
+correct signal for SAC's ``(1 - done)`` bootstrap (a time-limit
+truncation should NOT zero the bootstrap; the reference approximates
+this with its ``max_ep_len`` done-bypass, ref ``sac/algorithm.py:241``).
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class GymnasiumEnv:
+    """Adapter over ``gymnasium.make`` (ref ``gym.make``, ``main.py:167``)."""
+
+    def __init__(self, name: str, seed: int | None = None, **kwargs):
+        import gymnasium
+
+        self.name = name
+        self.env = gymnasium.make(name, **kwargs)
+        # Seed the warmup action sampler (ref env.action_space.sample(),
+        # sac/algorithm.py:228) so fixed-seed runs are reproducible.
+        self.env.action_space.seed(seed)
+        space = self.env.action_space
+        self.act_dim = int(space.shape[0])
+        self.act_limit = float(space.high[0])
+        obs_dim = int(self.env.observation_space.shape[0])
+        self.obs_spec = jax.ShapeDtypeStruct((obs_dim,), jnp.float32)
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        obs, _ = self.env.reset(seed=seed)
+        return np.asarray(obs, np.float32)
+
+    def step(self, action: np.ndarray):
+        obs, reward, terminated, truncated, _ = self.env.step(np.asarray(action))
+        return np.asarray(obs, np.float32), float(reward), bool(terminated), bool(truncated)
+
+    def sample_action(self) -> np.ndarray:
+        return np.asarray(self.env.action_space.sample(), np.float32)
+
+    def render(self):
+        return self.env.render()
+
+    def close(self):
+        self.env.close()
+
+
+class DmControlEnv:
+    """Generic dm_control suite task with flattened observations.
+
+    Covers what the reference reaches through its gym wrapper for
+    dm_control tasks; observation dict values are concatenated in key
+    order into one flat float32 vector.
+    """
+
+    def __init__(self, domain: str, task: str, seed: int | None = None):
+        from dm_control import suite
+
+        self.name = f"dm:{domain}:{task}"
+        self.env = suite.load(domain, task, task_kwargs={"random": seed})
+        spec = self.env.action_spec()
+        self.act_dim = int(np.prod(spec.shape))
+        self.act_limit = float(spec.maximum[0])
+        self._action_spec = spec
+        self._rng = np.random.default_rng(seed)
+        obs_dim = sum(
+            int(np.prod(v.shape)) if v.shape else 1
+            for v in self.env.observation_spec().values()
+        )
+        self.obs_spec = jax.ShapeDtypeStruct((obs_dim,), jnp.float32)
+
+    def _flatten(self, obs_dict) -> np.ndarray:
+        return np.concatenate(
+            [np.ravel(np.asarray(v, np.float32)) for v in obs_dict.values()]
+        )
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        ts = self.env.reset()
+        return self._flatten(ts.observation)
+
+    def step(self, action: np.ndarray):
+        ts = self.env.step(np.asarray(action))
+        # dm_control episodes end only by time limit (ts.last() with
+        # discount==1.0 is a truncation, not a terminal state).
+        terminated = bool(ts.last() and ts.discount == 0.0)
+        truncated = bool(ts.last() and not terminated)
+        return self._flatten(ts.observation), float(ts.reward or 0.0), terminated, truncated
+
+    def sample_action(self) -> np.ndarray:
+        spec = self._action_spec
+        return self._rng.uniform(spec.minimum, spec.maximum).astype(np.float32)
+
+    def render(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def make_env(name: str, seed: int | None = None, **kwargs):
+    """Single env factory (replaces ``gym.make`` dispatch +
+    string-matching in ref ``main.py:63,100-110,167``)."""
+    if name == "DeepMindWallRunner-v0":
+        from torch_actor_critic_tpu.envs.wall_runner import DeepMindWallRunner
+
+        return DeepMindWallRunner(seed=seed)
+    if name.startswith("dm:"):
+        _, domain, task = name.split(":")
+        return DmControlEnv(domain, task, seed=seed)
+    return GymnasiumEnv(name, seed=seed, **kwargs)
+
+
+def is_visual_env(name: str) -> bool:
+    """Mixed-observation envs need the visual model/buffer stack
+    (ref string dispatch at ``main.py:63,105``)."""
+    return name == "DeepMindWallRunner-v0"

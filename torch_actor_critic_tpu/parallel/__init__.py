@@ -1,0 +1,10 @@
+from torch_actor_critic_tpu.parallel.mesh import make_mesh  # noqa: F401
+from torch_actor_critic_tpu.parallel.dp import (  # noqa: F401
+    DataParallelSAC,
+    init_sharded_buffer,
+    shard_chunk,
+)
+from torch_actor_critic_tpu.parallel.distributed import (  # noqa: F401
+    initialize_multihost,
+    is_coordinator,
+)

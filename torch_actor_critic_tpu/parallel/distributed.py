@@ -1,0 +1,77 @@
+"""Multi-host process bootstrap.
+
+The reference launches workers by re-exec'ing itself under ``mpirun``
+and letting every rank re-run ``main()`` (``mpi_fork``, ref
+``sac/mpi.py:10-34``), with rank-0 gating via ``proc_id() == 0``
+(ref ``main.py:135``). The JAX equivalents:
+
+- :func:`initialize_multihost` — ``jax.distributed.initialize`` joins
+  this host's devices into the global runtime (ICI within a slice, DCN
+  across hosts). Launch one process per host with your scheduler
+  (GKE/xmanager/srun/...); no self-re-exec.
+- :func:`is_coordinator` — ``jax.process_index() == 0``, the rank-0
+  gate for logging/checkpointing.
+
+On a single host (including the CPU-simulated 8-device mesh used in
+tests) no initialization is needed; :func:`initialize_multihost` is a
+no-op unless coordinator/process info is provided via args or the
+standard cluster env vars.
+"""
+
+from __future__ import annotations
+
+import logging
+import typing as t
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the multi-host runtime if configured; no-op otherwise.
+
+    With no arguments, relies on ``jax.distributed.initialize``'s
+    auto-detection from cluster env vars; if neither args nor env are
+    present, stays single-host.
+    """
+    import os
+
+    auto_env = any(
+        v in os.environ
+        for v in (
+            "JAX_COORDINATOR_ADDRESS",
+            "COORDINATOR_ADDRESS",
+            "MEGASCALE_COORDINATOR_ADDRESS",
+        )
+    )
+    if coordinator_address is None and not auto_env:
+        logger.debug("single-host run; skipping jax.distributed.initialize")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "joined multihost runtime: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def is_coordinator() -> bool:
+    """Rank-0 gate (ref ``proc_id() == 0``, ``sac/mpi.py:37-39``)."""
+    return jax.process_index() == 0
+
+
+def process_info() -> t.Tuple[int, int]:
+    """(process_index, process_count) — ref ``proc_id``/``num_procs``
+    (``sac/mpi.py:37-43``)."""
+    return jax.process_index(), jax.process_count()

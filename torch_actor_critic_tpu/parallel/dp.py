@@ -1,0 +1,205 @@
+"""Synchronous data-parallel SAC over a device mesh.
+
+The TPU-native re-design of the reference's MPI data parallelism
+(SURVEY.md §2): each worker owns a model replica, its own env stream
+and its own replay buffer, with gradients allreduce-averaged per step
+(ref ``sac/algorithm.py:138``, ``sac/mpi.py:77-85``) and params
+broadcast from rank 0 at start (ref ``sac/algorithm.py:198-200``).
+
+Mapping:
+
+================================  =====================================
+reference (MPI)                    here (mesh)
+================================  =====================================
+``mpirun -np N`` re-exec fork      one controller, ``Mesh`` over devices
+per-rank replica + buffer          replicated params, ``dp``-sharded
+                                   :class:`BufferState` (leading device
+                                   axis)
+``mpi_avg_grads`` per update       ``lax.pmean`` *inside* the compiled
+                                   burst, riding ICI
+``sync_params`` Bcast              params device_put replicated once;
+                                   pmean'd grads keep replicas
+                                   bit-identical thereafter
+per-rank seeds ``10000*rank``      ``fold_in(rng, axis_index('dp'))``
+per-step stat send/recv            metrics ``pmean`` in-program (the
+                                   reference's per-step blocking
+                                   exchange, ref ``algorithm.py:262-271``,
+                                   moves off the hot path entirely)
+================================  =====================================
+
+The whole N-device burst — push N env chunks, run K gradient steps with
+cross-device averaging — is ONE ``shard_map``-ped jitted call.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torch_actor_critic_tpu.buffer.replay import init_replay_buffer
+from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
+from torch_actor_critic_tpu.sac.algorithm import SAC, Metrics
+
+
+def _dp_specs(mesh: Mesh):
+    dp_spec = P("dp")
+    rep_spec = P()
+    return dp_spec, rep_spec
+
+
+def init_sharded_buffer(
+    capacity_per_device: int,
+    obs_spec: t.Any,
+    act_dim: int,
+    mesh: Mesh,
+) -> BufferState:
+    """Per-device replay shards as one ``BufferState`` with a leading
+    ``dp`` axis on every leaf (data ``(n_dev, cap, ...)``, ptr/size
+    ``(n_dev,)``), sharded ``P('dp')`` — the analogue of the reference's
+    per-worker buffers built post-fork (ref ``main.py:141,168``).
+    """
+    n_dev = mesh.shape["dp"]
+    single = init_replay_buffer(capacity_per_device, obs_spec, act_dim)
+
+    def rep(x):
+        return jnp.broadcast_to(x[None], (n_dev,) + x.shape)
+
+    state = jax.tree_util.tree_map(rep, single)
+    sharding = NamedSharding(mesh, P("dp"))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), state)
+
+
+def shard_chunk(chunk: Batch, mesh: Mesh) -> Batch:
+    """Place a host-built chunk with leading axes ``(n_dev, per_dev, ...)``
+    onto the ``dp`` axis of the mesh."""
+    sharding = NamedSharding(mesh, P("dp"))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), chunk)
+
+
+class DataParallelSAC:
+    """Wraps a :class:`~torch_actor_critic_tpu.sac.algorithm.SAC` learner
+    with a mesh; exposes the same functional surface, compiled for DP.
+
+    Single-device training is just ``dp=1`` — one code path, no
+    "degrades to no-ops when world size is 1" special-casing (cf. ref
+    ``sac/mpi.py:79-80,94-95``).
+    """
+
+    AXIS = "dp"
+
+    def __init__(self, sac: SAC, mesh: Mesh):
+        self.sac = sac
+        self.mesh = mesh
+        self.n_devices = mesh.shape["dp"]
+        self._burst = None
+        self._push = None
+        self._select_action = None
+
+    # ----------------------------------------------------------- state init
+
+    def init_state(self, key: jax.Array, example_obs: t.Any) -> TrainState:
+        """Initialize once and replicate across the mesh — the moral
+        equivalent of rank-0 init + ``sync_params`` Bcast
+        (ref ``sac/algorithm.py:198-200``); thereafter pmean'd grads
+        keep every replica bit-identical."""
+        state = self.sac.init_state(key, example_obs)
+        rep = NamedSharding(self.mesh, P())
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), state)
+
+    # ----------------------------------------------------------- the burst
+
+    def _build_burst(self, num_updates: int):
+        sac = self.sac
+        mesh = self.mesh
+        dp_spec, rep_spec = _dp_specs(mesh)
+
+        def burst_body(state: TrainState, buffer: BufferState, chunk: Batch):
+            # Per-shard view: strip the leading device axis shard_map
+            # leaves on the block arguments.
+            buffer = jax.tree_util.tree_map(lambda x: x[0], buffer)
+            chunk = jax.tree_util.tree_map(lambda x: x[0], chunk)
+
+            # Decorrelate per-device noise/sampling streams — the
+            # analogue of per-rank seeds (ref sac/algorithm.py:203-205).
+            dev = jax.lax.axis_index(DataParallelSAC.AXIS)
+            local = state.replace(rng=jax.random.fold_in(state.rng, dev))
+
+            local, buffer, metrics = sac.update_burst(
+                local, buffer, chunk, num_updates, axis_name=DataParallelSAC.AXIS
+            )
+            # Params/opt-states are replicated (pmean'd grads); restore a
+            # replicated rng stream derived from the pre-burst key so the
+            # output TrainState is identical on every device.
+            state_out = local.replace(
+                rng=jax.random.fold_in(state.rng, jnp.uint32(0xB0057))
+            )
+            metrics = jax.lax.pmean(metrics, DataParallelSAC.AXIS)
+            # Re-attach the device axis for the dp-sharded outputs.
+            buffer = jax.tree_util.tree_map(lambda x: x[None], buffer)
+            return state_out, buffer, metrics
+
+        mapped = jax.shard_map(
+            burst_body,
+            mesh=mesh,
+            in_specs=(rep_spec, dp_spec, dp_spec),
+            out_specs=(rep_spec, dp_spec, rep_spec),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def update_burst(
+        self,
+        state: TrainState,
+        buffer: BufferState,
+        chunk: Batch,
+        num_updates: int,
+    ) -> t.Tuple[TrainState, BufferState, Metrics]:
+        """Push per-device chunks and run ``num_updates`` DP gradient
+        steps as one device dispatch. ``chunk`` leaves have leading axes
+        ``(n_dev, per_dev, ...)`` (see :func:`shard_chunk`)."""
+        if self._burst is None or self._burst[0] != num_updates:
+            self._burst = (num_updates, self._build_burst(num_updates))
+        return self._burst[1](state, buffer, chunk)
+
+    def push_chunk(self, buffer: BufferState, chunk: Batch) -> BufferState:
+        """Store per-device chunks without gradient steps — the warmup
+        path before ``update_after`` (the reference stores every step
+        but only updates after warmup, ref ``sac/algorithm.py:249,273``).
+        """
+        if self._push is None:
+            from torch_actor_critic_tpu.buffer.replay import push
+
+            dp_spec, _ = _dp_specs(self.mesh)
+
+            def body(buffer, chunk):
+                buffer = jax.tree_util.tree_map(lambda x: x[0], buffer)
+                chunk = jax.tree_util.tree_map(lambda x: x[0], chunk)
+                out = push(buffer, chunk)
+                return jax.tree_util.tree_map(lambda x: x[None], out)
+
+            self._push = jax.jit(
+                jax.shard_map(
+                    body,
+                    mesh=self.mesh,
+                    in_specs=(dp_spec, dp_spec),
+                    out_specs=dp_spec,
+                    check_vma=False,
+                ),
+                donate_argnums=(0,),
+            )
+        return self._push(buffer, chunk)
+
+    # ------------------------------------------------------------- acting
+
+    def select_action(self, params, obs, key=None, deterministic: bool = False):
+        """Batched action selection for the host env loop (replicated
+        params, host-resident obs)."""
+        if self._select_action is None:
+            self._select_action = jax.jit(
+                self.sac.select_action, static_argnames=("deterministic",)
+            )
+        return self._select_action(params, obs, key, deterministic=deterministic)

@@ -1,0 +1,54 @@
+"""Device-mesh construction.
+
+The TPU-native replacement for the reference's MPI world
+(``mpi_fork``/``proc_id``/``num_procs``, ref ``sac/mpi.py:10-43``): a
+``jax.sharding.Mesh`` over ICI (and DCN across hosts) with named axes
+
+- ``dp`` — data parallelism: per-device replay shards + batches,
+  gradients averaged with ``lax.pmean`` (the reference's one strategy,
+  SURVEY.md §2 "Parallelism strategies").
+- ``tp`` — tensor parallelism for wide models: parameters sharded over
+  hidden dimensions via GSPMD annotations
+  (:mod:`torch_actor_critic_tpu.parallel.sharding`). An extension
+  beyond the reference's capability envelope; ``tp=1`` (default)
+  reduces to pure DP.
+
+Where the reference re-execs itself under ``mpirun`` and every rank
+re-runs ``main()`` (ref ``sac/mpi.py:24-34``), a JAX mesh is just data:
+one controller process (per host) sees all local devices, and
+multi-host meshes stitch hosts together after
+``jax.distributed.initialize`` (see
+:mod:`torch_actor_critic_tpu.parallel.distributed`).
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    dp: int | None = None,
+    tp: int = 1,
+    devices: t.Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a ``(dp, tp)`` mesh.
+
+    ``dp=None`` uses all available devices (divided by ``tp``). The
+    ``dp`` axis is laid out over the fastest-varying device order so DP
+    collectives ride ICI neighbors.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if dp is None:
+        if n % tp != 0:
+            raise ValueError(f"{n} devices not divisible by tp={tp}")
+        dp = n // tp
+    if dp * tp > n:
+        raise ValueError(f"mesh ({dp}x{tp}) needs {dp * tp} devices, have {n}")
+    grid = np.asarray(devices[: dp * tp]).reshape(dp, tp)
+    return Mesh(grid, axis_names=("dp", "tp"))

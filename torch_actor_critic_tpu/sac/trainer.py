@@ -1,0 +1,407 @@
+"""Host training shell: env stepping, staging, bursts, metrics, ckpt.
+
+The re-design of the reference's ``SAC.train`` loop (ref
+``sac/algorithm.py:182-307``) for the host<->TPU boundary (SURVEY.md §7
+hard-part (a)). Structure per epoch:
+
+- one **vectorized policy call** per env step for all ``n_envs`` envs
+  (the reference runs one env per MPI rank, stepping under
+  ``torch.no_grad`` per process, ref ``:227-236``);
+- transitions accumulate in a host **staging buffer** and cross to the
+  device once per ``update_every`` window — either a pure push (warmup;
+  ref stores every step, ``:249``) or the fused
+  push+K-updates burst (ref inner loop ``:274-283``), so
+  host<->device traffic is ~2 transfers per 50 env steps instead of
+  the reference's per-update sample conversion;
+- episode bookkeeping, the ``max_ep_len`` done-bypass (ref ``:241``)
+  expressed as gymnasium truncation, per-epoch metric means under the
+  reference's metric names (``episode_length``, ``reward``, ``loss_q``,
+  ``loss_pi``, ref ``:285-290``), tqdm progress (ref ``:213,299``);
+- rank-0-gated checkpoint every ``save_every`` epochs
+  (ref ``:291-293``) via Orbax, and metric logging via the tracker.
+
+One env per ``dp`` mesh slice feeds that device's replay shard —
+exactly the reference's worker<->buffer pairing (per-rank env + buffer,
+SURVEY.md §2 "Parallelism strategies") with ranks -> mesh slices.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import typing as t
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torch_actor_critic_tpu.core.types import Batch, MultiObservation
+from torch_actor_critic_tpu.envs import make_env
+from torch_actor_critic_tpu.envs.wrappers import is_visual_env
+from torch_actor_critic_tpu.models import Actor, DoubleCritic, VisualActor, VisualDoubleCritic
+from torch_actor_critic_tpu.parallel import (
+    DataParallelSAC,
+    init_sharded_buffer,
+    make_mesh,
+    shard_chunk,
+)
+from torch_actor_critic_tpu.parallel.distributed import is_coordinator
+from torch_actor_critic_tpu.sac.algorithm import SAC
+from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
+from torch_actor_critic_tpu.utils.config import SACConfig
+from torch_actor_critic_tpu.utils.normalize import IdentityNormalizer, WelfordNormalizer
+from torch_actor_critic_tpu.utils.tracking import Tracker
+
+logger = logging.getLogger(__name__)
+
+
+def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
+    """Model-family dispatch on observation structure — the typed
+    replacement of the reference's env-name string dispatch
+    (ref ``main.py:63-90``)."""
+    if isinstance(env.obs_spec, MultiObservation):
+        actor = VisualActor(
+            act_dim=env.act_dim,
+            hidden_sizes=config.hidden_sizes,
+            act_limit=env.act_limit,
+            cnn_features=config.cnn_features,
+            normalize_pixels=config.normalize_pixels,
+        )
+        critic = VisualDoubleCritic(
+            hidden_sizes=config.hidden_sizes,
+            cnn_features=config.cnn_features,
+            normalize_pixels=config.normalize_pixels,
+            num_qs=config.num_qs,
+        )
+    else:
+        actor = Actor(
+            act_dim=env.act_dim,
+            hidden_sizes=config.hidden_sizes,
+            act_limit=env.act_limit,
+        )
+        critic = DoubleCritic(hidden_sizes=config.hidden_sizes, num_qs=config.num_qs)
+    return actor, critic
+
+
+def _stack_obs(obs_list: t.Sequence) -> t.Any:
+    """Stack a list of observation pytrees along a new leading axis."""
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *obs_list)
+
+
+class Trainer:
+    """End-to-end SAC training over a device mesh.
+
+    ``n_envs`` host envs (default: one per dp slice) step in lockstep;
+    per-rank seeds follow the reference's ``10000 * rank`` scheme
+    (ref ``sac/algorithm.py:203-205``).
+    """
+
+    def __init__(
+        self,
+        env_name: str,
+        config: SACConfig | None = None,
+        mesh=None,
+        tracker: Tracker | None = None,
+        checkpointer: Checkpointer | None = None,
+        seed: int = 0,
+    ):
+        self.config = config or SACConfig()
+        self.env_name = env_name
+        self.seed = seed
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_envs = self.mesh.shape["dp"]
+        self.tracker = tracker
+        self.checkpointer = checkpointer
+
+        self.envs = [
+            make_env(env_name, seed=seed + 10000 * i) for i in range(self.n_envs)
+        ]
+        env0 = self.envs[0]
+        self.visual = is_visual_env(env_name)
+        if self.config.normalize_observations and not self.visual:
+            self.normalizer = WelfordNormalizer(env0.obs_spec.shape[0])
+        else:
+            self.normalizer = IdentityNormalizer()
+
+        actor_def, critic_def = build_models(self.config, env0)
+        self.sac = SAC(self.config, actor_def, critic_def, env0.act_dim)
+        self.dp = DataParallelSAC(self.sac, self.mesh)
+
+        # Actor/learner split (Podracer-style): action selection runs on
+        # the host CPU backend against a param mirror refreshed once per
+        # update window, so the env loop never blocks on accelerator
+        # dispatch latency (one small-param transfer per ~50 steps
+        # instead of one RPC per env step). Indispensable when the TPU
+        # sits behind a high-latency tunnel; harmless otherwise.
+        self._host_device = (
+            jax.local_devices(backend="cpu")[0] if self.config.host_actor else None
+        )
+        self._host_params = None  # refreshed lazily after each burst
+        self._host_select = (
+            jax.jit(
+                self.sac.select_action,
+                static_argnames=("deterministic",),
+                backend="cpu",
+            )
+            if self.config.host_actor
+            else None
+        )
+        # One-transfer param mirroring: the accelerator may sit behind a
+        # high-latency link where every fetch pays a fixed RPC cost, so
+        # params are flattened into a single buffer on-device and
+        # fetched with ONE transfer, then unflattened host-side.
+        self._flatten_params = jax.jit(
+            lambda p: jnp.concatenate(
+                [jnp.ravel(x) for x in jax.tree_util.tree_leaves(p)]
+            )
+        )
+        self._param_struct = None  # (treedef, shapes, sizes) cache
+
+        key = jax.random.key(seed)
+        if self.config.host_actor:
+            key = jax.device_put(key, self._host_device)
+        self._act_key, init_key = jax.random.split(key)
+        example_obs = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), env0.obs_spec
+        )
+        self.state = self.dp.init_state(init_key, example_obs)
+        per_dev_capacity = max(self.config.buffer_size // self.n_envs, 1)
+        self.buffer = init_sharded_buffer(
+            per_dev_capacity, env0.obs_spec, env0.act_dim, self.mesh
+        )
+        self.start_epoch = 0
+
+    # ------------------------------------------------------------ helpers
+
+    def _normalize(self, obs, update: bool):
+        if isinstance(self.normalizer, IdentityNormalizer):
+            return obs
+        return self.normalizer.normalize(obs, update=update)
+
+    def _policy_actions(self, obs_list, deterministic=False) -> np.ndarray:
+        obs_batch = _stack_obs(obs_list)
+        self._act_key, sub = jax.random.split(self._act_key)
+        if self.config.host_actor:
+            if self._host_params is None:
+                self._host_params = self._fetch_params_single_transfer()
+            actions = self._host_select(
+                self._host_params, obs_batch, sub, deterministic=deterministic
+            )
+        else:
+            actions = self.dp.select_action(
+                self.state.actor_params, obs_batch, sub, deterministic=deterministic
+            )
+        return np.asarray(actions)
+
+    def _fetch_params_single_transfer(self):
+        """Mirror actor params to the host with one device->host copy."""
+        params = self.state.actor_params
+        if self._param_struct is None:
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            shapes = [x.shape for x in leaves]
+            sizes = [int(np.prod(s)) for s in shapes]
+            self._param_struct = (treedef, shapes, sizes)
+        treedef, shapes, sizes = self._param_struct
+        flat = np.asarray(self._flatten_params(params))  # one transfer
+        splits = np.split(flat, np.cumsum(sizes)[:-1])
+        leaves = [s.reshape(shape) for s, shape in zip(splits, shapes)]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _build_chunk(self, staging) -> Batch:
+        """staging[i] is a list of per-env transition tuples; result has
+        leading axes (n_envs, window)."""
+
+        def stack_field(idx):
+            per_env = [
+                _stack_obs([tr[idx] for tr in env_stage]) for env_stage in staging
+            ]
+            return _stack_obs(per_env)
+
+        return Batch(
+            states=stack_field(0),
+            actions=stack_field(1),
+            rewards=stack_field(2).astype(np.float32),
+            next_states=stack_field(3),
+            done=stack_field(4).astype(np.float32),
+        )
+
+    # -------------------------------------------------------------- train
+
+    def train(self, render: bool = False) -> dict:
+        cfg = self.config
+        n = self.n_envs
+
+        obs = [
+            self._normalize(env.reset(seed=self.seed + 10000 * i), update=True)
+            for i, env in enumerate(self.envs)
+        ]
+        ep_ret = np.zeros(n)
+        ep_len = np.zeros(n, np.int64)
+        staging: t.List[list] = [[] for _ in range(n)]
+
+        step = 0  # lockstep iteration count (the reference's per-rank `step`)
+        last_metrics: dict = {}
+        episode_rewards: list = []
+        episode_lengths: list = []
+
+        try:
+            import tqdm
+
+            epoch_iter = tqdm.trange(
+                self.start_epoch,
+                self.start_epoch + cfg.epochs,
+                ncols=0,
+                initial=self.start_epoch,
+            )
+        except ImportError:  # pragma: no cover
+            epoch_iter = range(self.start_epoch, self.start_epoch + cfg.epochs)
+
+        t_epoch = time.time()
+        for e in epoch_iter:
+            losses_q, losses_pi = [], []
+            env_steps_this_epoch = 0
+
+            for t_ in range(cfg.steps_per_epoch):
+                # --- action selection (ref :227-236) ---
+                if step < cfg.start_steps:
+                    actions = np.stack([env.sample_action() for env in self.envs])
+                else:
+                    actions = self._policy_actions(obs)
+
+                # --- env step + bookkeeping (ref :238-260) ---
+                epoch_ended = t_ == cfg.steps_per_epoch - 1
+                for i, env in enumerate(self.envs):
+                    next_obs, reward, terminated, truncated = env.step(actions[i])
+                    next_obs = self._normalize(next_obs, update=True)
+                    ep_len[i] += 1
+                    ep_ret[i] += reward
+                    # max_ep_len bypass (ref :241): an episode cut by the
+                    # length cap is a truncation — do not zero the
+                    # bootstrap.
+                    hit_cap = ep_len[i] >= cfg.max_ep_len
+                    done_for_buffer = float(terminated and not hit_cap)
+                    staging[i].append(
+                        (obs[i], actions[i], reward, next_obs, done_for_buffer)
+                    )
+                    obs[i] = next_obs
+
+                    if render and i == 0 and is_coordinator():
+                        env.render()
+
+                    if terminated or truncated or hit_cap or epoch_ended:
+                        episode_rewards.append(float(ep_ret[i]))
+                        episode_lengths.append(int(ep_len[i]))
+                        obs[i] = self._normalize(env.reset(), update=True)
+                        ep_ret[i] = 0.0
+                        ep_len[i] = 0
+                env_steps_this_epoch += n
+
+                # --- device window: push or push+update (ref :273-283) ---
+                window_full = (step + 1) % cfg.update_every == 0
+                if window_full:
+                    chunk = shard_chunk(self._build_chunk(staging), self.mesh)
+                    staging = [[] for _ in range(n)]
+                    if step > cfg.update_after:
+                        self.state, self.buffer, m = self.dp.update_burst(
+                            self.state, self.buffer, chunk, cfg.update_every
+                        )
+                        self._host_params = None  # mirror is stale
+                        # Keep device scalars; materialize at epoch end
+                        # so bursts stay async behind the env loop.
+                        losses_q.append(m["loss_q"])
+                        losses_pi.append(m["loss_pi"])
+                    else:
+                        self.buffer = self.dp.push_chunk(self.buffer, chunk)
+
+                step += 1
+
+            # --- end of epoch: metrics + checkpoint (ref :285-296) ---
+            dt = time.time() - t_epoch
+            t_epoch = time.time()
+            last_metrics = {
+                "episode_length": float(np.mean(episode_lengths)) if episode_lengths else 0.0,
+                "reward": float(np.mean(episode_rewards)) if episode_rewards else 0.0,
+                # one stacked fetch per loss series, not one RPC per burst
+                "loss_q": float(jnp.mean(jnp.stack(losses_q))) if losses_q else 0.0,
+                "loss_pi": float(jnp.mean(jnp.stack(losses_pi))) if losses_pi else 0.0,
+                "env_steps_per_sec": env_steps_this_epoch / dt,
+                "grad_steps_per_sec": (len(losses_q) * cfg.update_every) / dt,
+            }
+            if is_coordinator() and self.tracker is not None:
+                self.tracker.log_metrics(last_metrics, e)
+            # Orbax saves of sharded arrays are collective: EVERY process
+            # must call save (each host owns shards of the dp-sharded
+            # buffer); rank-gating applies only to metric logging.
+            if self.checkpointer is not None and e % cfg.save_every == 0:
+                self.checkpointer.save(
+                    e,
+                    self.state,
+                    self.buffer,
+                    extra={"config": self.config.to_json(),
+                           "normalizer": self.normalizer.state_dict()},
+                )
+            if hasattr(epoch_iter, "set_postfix"):
+                epoch_iter.set_postfix({**last_metrics, "step": step})
+
+            # (envs were already reset by the epoch_ended branch above —
+            # the reference's extra epoch-boundary reset, ref :305, is a
+            # redundant double physics re-init we deliberately drop)
+            episode_rewards, episode_lengths = [], []
+
+        if self.checkpointer is not None:
+            self.checkpointer.wait()
+        return last_metrics
+
+    # ------------------------------------------------------------- resume
+
+    def restore(self, epoch: int | None = None, include_buffer: bool = True) -> int:
+        """Resume full state (incl. buffer + normalizer) from the
+        checkpointer — strictly more than the reference's
+        ``load_session`` (ref ``main.py:28-51``, which drops buffer and
+        target critic). ``include_buffer=False`` restores weights only
+        (the eval CLI path, where buffer shapes may not match the eval
+        mesh)."""
+        if self.checkpointer is None:
+            raise ValueError("no checkpointer configured")
+        state, buffer, meta = self.checkpointer.restore(
+            jax.tree_util.tree_map(lambda x: x, self.state),
+            self.buffer if include_buffer else None,
+            epoch=epoch,
+        )
+        self.state = state
+        self._host_params = None  # mirror is stale
+        if buffer is not None:
+            self.buffer = buffer
+        if "normalizer" in meta and meta["normalizer"]:
+            self.normalizer.load_state_dict(meta["normalizer"])
+        self.start_epoch = int(meta["epoch"]) + 1
+        return self.start_epoch
+
+    # --------------------------------------------------------------- eval
+
+    def evaluate(
+        self, episodes: int = 10, deterministic: bool = True, render: bool = False
+    ) -> dict:
+        """Rollout loop (ref ``run_agent.run_agent``, ``run_agent.py:19-48``)."""
+        env = self.envs[0]
+        returns, lengths = [], []
+        for _ in range(episodes):
+            o = self._normalize(env.reset(), update=False)
+            done = False
+            ret, length = 0.0, 0
+            while not done and length < self.config.max_ep_len:
+                a = self._policy_actions([o], deterministic=deterministic)[0]
+                o, r, terminated, truncated = env.step(a)
+                o = self._normalize(o, update=False)
+                ret += r
+                length += 1
+                done = terminated or truncated
+                if render:
+                    env.render()
+            returns.append(ret)
+            lengths.append(length)
+        return {
+            "ep_ret_mean": float(np.mean(returns)),
+            "ep_ret_std": float(np.std(returns)),
+            "ep_len_mean": float(np.mean(lengths)),
+        }

@@ -1,0 +1,151 @@
+"""Training CLI.
+
+Surface twin of the reference ``main.py`` (ref ``main.py:113-185``):
+
+    python -m torch_actor_critic_tpu.train --environment HalfCheetah-v5
+    python -m torch_actor_critic_tpu.train --run <id>   # resume
+
+Differences, by design:
+
+- ``--devices`` replaces ``--cpus``: parallelism is a device mesh, not
+  an ``mpirun`` re-exec (ref ``mpi_fork``, ``sac/mpi.py:10-34``).
+- hyperparameters are CLI-overridable typed flags (ref hardcodes a dict,
+  ``main.py:147-160``) and persist as JSON, not MLflow param strings.
+- resume restores the FULL state incl. replay buffer, target critic and
+  normalizer (ref drops all three, SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+
+from torch_actor_critic_tpu.parallel import make_mesh
+from torch_actor_critic_tpu.parallel.distributed import (
+    initialize_multihost,
+    is_coordinator,
+)
+from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
+from torch_actor_critic_tpu.utils.config import SACConfig
+from torch_actor_critic_tpu.utils.tracking import Tracker
+
+logging.basicConfig(level=logging.INFO)
+logger = logging.getLogger(__name__)
+
+
+def parse_arguments(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        "Soft Actor-Critic trainer for MuJoCo/dm_control on TPU."
+    )
+    # Reference surface (ref main.py:113-125)
+    parser.add_argument("--run", type=str, default=None, help="Run id to resume")
+    parser.add_argument("--experiment", default="Default", help="Experiment name")
+    parser.add_argument(
+        "--disable-logging", dest="logging", action="store_false", help="Turn off logging"
+    )
+    parser.add_argument(
+        "--render", dest="render", action="store_true", help="Render the environment"
+    )
+    parser.add_argument(
+        "--environment", default="HalfCheetah-v5", help="Environment to use"
+    )
+    parser.add_argument(
+        "--devices",
+        type=int,
+        default=None,
+        help="Data-parallel width (default: all visible devices)",
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--runs-root", default="runs", help="Tracking root directory")
+    parser.add_argument(
+        "--no-save-buffer",
+        dest="save_buffer",
+        action="store_false",
+        help="Exclude the replay buffer from checkpoints",
+    )
+    # Every SACConfig field becomes a flag (--batch-size, --learn-alpha, ...).
+    for f in dataclasses.fields(SACConfig):
+        flag = "--" + f.name.replace("_", "-")
+        if f.type == "bool" or isinstance(f.default, bool):
+            parser.add_argument(
+                flag, type=lambda s: s.lower() in ("1", "true", "yes"), default=None
+            )
+        elif f.name == "hidden_sizes":
+            parser.add_argument(
+                flag, type=lambda s: tuple(int(x) for x in s.split(",")), default=None
+            )
+        elif f.name == "target_entropy":
+            parser.add_argument(flag, type=float, default=None)
+        else:
+            parser.add_argument(flag, type=type(f.default), default=None)
+    parser.set_defaults(logging=True, render=False, save_buffer=True)
+    return parser.parse_args(argv)
+
+
+def config_from_args(args: argparse.Namespace) -> SACConfig:
+    overrides = {}
+    for f in dataclasses.fields(SACConfig):
+        v = getattr(args, f.name, None)
+        if v is not None:
+            overrides[f.name] = v
+    return SACConfig(**overrides)
+
+
+def main(argv=None):
+    args = parse_arguments(argv)
+    initialize_multihost()
+
+    from torch_actor_critic_tpu.sac.trainer import Trainer  # jax-heavy import
+
+    tracker = Tracker(
+        experiment=args.experiment,
+        run_id=args.run,
+        root=args.runs_root,
+        enabled=args.logging and is_coordinator(),
+    )
+
+    if args.run is not None:
+        # Resume: config comes from the run's stored params
+        # (ref load_session, main.py:28-51).
+        stored = tracker.params()
+        config = SACConfig.from_json(json.dumps(stored.get("config", {})))
+        env_name = stored.get("environment", args.environment)
+    else:
+        config = config_from_args(args)
+        env_name = args.environment
+        tracker.log_params(
+            {
+                "environment": env_name,
+                "config": json.loads(config.to_json()),
+                "buffer_size": config.buffer_size,
+            }
+        )
+
+    mesh = make_mesh(dp=args.devices)
+    checkpointer = Checkpointer(
+        tracker.artifact_path("checkpoints"), save_buffer=args.save_buffer
+    )
+    trainer = Trainer(
+        env_name,
+        config,
+        mesh=mesh,
+        tracker=tracker,
+        checkpointer=checkpointer,
+        seed=args.seed,
+    )
+    if args.run is not None and checkpointer.latest_epoch() is not None:
+        start = trainer.restore()
+        logger.info("resumed run %s at epoch %d", tracker.run_id, start)
+
+    logger.info(
+        "training %s on mesh %s (run %s)", env_name, dict(mesh.shape), tracker.run_id
+    )
+    metrics = trainer.train(render=args.render)
+    logger.info("final metrics: %s", metrics)
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Orbax checkpoint/resume of the COMPLETE training state.
+
+The reference checkpoints actor/critic modules + optimizer state + epoch
+through MLflow (ref ``sac/algorithm.py:164-180``) and on resume rebuilds
+the target critic by deepcopy and restarts with an EMPTY replay buffer
+(ref ``main.py:28-51``, SURVEY.md §3.5) — i.e. resumed runs are not the
+same runs. Here one Orbax composite persists strictly more:
+
+- the full :class:`TrainState` (params, target params, both opt states,
+  learned-temperature state, PRNG key, step counter),
+- optionally the full sharded replay :class:`BufferState`,
+- the epoch + config JSON.
+
+Restore round-trips device placement/sharding from abstract pytrees, so
+a multi-chip run resumes onto the same mesh layout.
+"""
+
+from __future__ import annotations
+
+import typing as t
+from pathlib import Path
+
+import jax
+import orbax.checkpoint as ocp
+
+from torch_actor_critic_tpu.core.types import BufferState, TrainState
+
+
+class Checkpointer:
+    def __init__(
+        self,
+        directory: str | Path,
+        max_to_keep: int = 3,
+        save_buffer: bool = True,
+    ):
+        self.directory = Path(directory).absolute()
+        self.save_buffer = save_buffer
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(
+        self,
+        epoch: int,
+        train_state: TrainState,
+        buffer_state: BufferState | None = None,
+        extra: t.Mapping[str, t.Any] | None = None,
+        wait: bool = False,
+    ) -> None:
+        """Write checkpoint for ``epoch`` (async unless ``wait``)."""
+        items = {
+            "train_state": ocp.args.StandardSave(train_state),
+            "meta": ocp.args.JsonSave(dict(extra or {}, epoch=int(epoch))),
+        }
+        if buffer_state is not None and self.save_buffer:
+            items["buffer"] = ocp.args.StandardSave(buffer_state)
+        self._mgr.save(epoch, args=ocp.args.Composite(**items))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_epoch(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(
+        self,
+        abstract_train_state: TrainState,
+        abstract_buffer: BufferState | None = None,
+        epoch: int | None = None,
+    ) -> t.Tuple[TrainState, BufferState | None, dict]:
+        """Restore ``(train_state, buffer_state, meta)``.
+
+        Abstract pytrees come from ``jax.eval_shape`` over the init
+        functions (plus shardings); buffer restore is skipped if the
+        checkpoint has none.
+        """
+        epoch = epoch if epoch is not None else self._mgr.latest_step()
+        if epoch is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        items = {
+            "train_state": ocp.args.StandardRestore(abstract_train_state),
+            "meta": ocp.args.JsonRestore(),
+        }
+        # Only request the buffer if this checkpoint actually contains
+        # one (save_buffer may have been off). A shape/sharding mismatch
+        # on a present buffer must surface, not silently resume with an
+        # empty buffer — that is exactly the reference flaw (SURVEY.md
+        # §3.5) this module exists to fix.
+        saved_items = set(self._mgr.item_metadata(epoch).keys())
+        if abstract_buffer is not None and "buffer" in saved_items:
+            items["buffer"] = ocp.args.StandardRestore(abstract_buffer)
+        out = self._mgr.restore(epoch, args=ocp.args.Composite(**items))
+        return out["train_state"], out.get("buffer"), dict(out["meta"])
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -62,6 +62,11 @@ class SACConfig:
     # usable option).
     normalize_observations: bool = False
 
+    # Actor/learner split: run env-loop action selection on the host
+    # CPU backend against a param mirror refreshed per update window,
+    # instead of a per-step accelerator round trip.
+    host_actor: bool = True
+
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)
 

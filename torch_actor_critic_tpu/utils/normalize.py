@@ -1,0 +1,68 @@
+"""Online observation normalization (Welford).
+
+The reference ships a ``WelfordVarianceEstimate`` normalizer as dead
+code — defined with MLflow save/load hooks but never imported by the
+training path (ref ``sac/utils.py:27-65``, SURVEY.md §2 "State
+normalizers"). Here it is a *used* optional component
+(``SACConfig.normalize_observations``) with correct Welford updates:
+the reference's variance accumulator uses ``(x - old_mean)^2`` where
+Welford's algorithm requires ``(x - old_mean) * (x - new_mean)``
+(ref ``sac/utils.py:46-48``) — a deliberate fix, noted for parity
+accounting.
+
+Host-side numpy (it runs in the env loop on single observations);
+state is a plain dict so it checkpoints with everything else.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import numpy as np
+
+
+class WelfordNormalizer:
+    """y = (x - mean) / sqrt(var + eps), statistics updated online."""
+
+    def __init__(self, dim: int, eps: float = 1e-8):
+        self.mean = np.zeros(dim, np.float64)
+        self.m2 = np.zeros(dim, np.float64)
+        self.count = 0
+        self.eps = eps
+
+    def normalize(self, x: np.ndarray, update: bool = True) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        if update:
+            self.count += 1
+            delta = x - self.mean
+            self.mean += delta / self.count
+            self.m2 += delta * (x - self.mean)
+        var = self.m2 / max(self.count, 1)
+        return ((x - self.mean) / np.sqrt(var + self.eps)).astype(np.float32)
+
+    # ------------------------------------------------------- persistence
+
+    def state_dict(self) -> dict:
+        return {
+            "mean": self.mean.tolist(),
+            "m2": self.m2.tolist(),
+            "count": self.count,
+        }
+
+    def load_state_dict(self, d: t.Mapping) -> None:
+        self.mean = np.asarray(d["mean"], np.float64)
+        self.m2 = np.asarray(d["m2"], np.float64)
+        self.count = int(d["count"])
+
+
+class IdentityNormalizer:
+    """Pass-through (ref ``Identity``, ``sac/utils.py:68-79``)."""
+
+    def normalize(self, x: np.ndarray, update: bool = True) -> np.ndarray:
+        return np.asarray(x, np.float32)
+
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, d) -> None:
+        pass

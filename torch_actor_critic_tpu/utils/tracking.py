@@ -1,0 +1,94 @@
+"""File-based experiment tracking.
+
+Capability twin of the reference's MLflow usage (params at run start,
+per-epoch metrics, artifact storage, run-id resume — ref
+``main.py:132-138,161-164``, ``sac/algorithm.py:291-296``) without the
+MLflow dependency (not available in this image). Layout:
+
+    <root>/<experiment>/<run_id>/
+        params.json        # hyperparameters (typed, not stringly)
+        metrics.jsonl      # one {"step": e, **metrics} line per log
+        artifacts/         # checkpoints etc.
+
+``Tracker.load`` resumes an existing run by id, the counterpart of
+``mlflow.start_run(run_id)`` + ``load_session`` (ref ``main.py:28-51``).
+If mlflow IS importable, :class:`Tracker` can mirror logs to it
+(``mirror_mlflow=True``) for drop-in dashboard compatibility.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import typing as t
+import uuid
+from pathlib import Path
+
+
+class Tracker:
+    def __init__(
+        self,
+        experiment: str = "Default",
+        run_id: str | None = None,
+        root: str | Path = "runs",
+        enabled: bool = True,
+        mirror_mlflow: bool = False,
+    ):
+        self.enabled = enabled
+        self.experiment = experiment
+        self.run_id = run_id or uuid.uuid4().hex[:16]
+        self.run_dir = Path(root) / experiment / self.run_id
+        self.artifacts_dir = self.run_dir / "artifacts"
+        self._mlflow = None
+        if enabled:
+            self.artifacts_dir.mkdir(parents=True, exist_ok=True)
+            if mirror_mlflow:
+                try:
+                    import mlflow
+
+                    mlflow.set_experiment(experiment)
+                    mlflow.start_run(run_name=self.run_id)
+                    self._mlflow = mlflow
+                except ImportError:
+                    pass
+
+    @classmethod
+    def load(cls, run_id: str, experiment: str = "Default", root="runs") -> "Tracker":
+        t_ = cls(experiment=experiment, run_id=run_id, root=root)
+        if not t_.run_dir.exists():
+            raise FileNotFoundError(f"run {run_id} not found under {t_.run_dir}")
+        return t_
+
+    # ------------------------------------------------------------------ api
+
+    def log_params(self, params: t.Mapping[str, t.Any]) -> None:
+        if not self.enabled:
+            return
+        existing = self.params()
+        existing.update(params)
+        (self.run_dir / "params.json").write_text(json.dumps(existing, indent=2))
+        if self._mlflow:
+            self._mlflow.log_params(dict(params))
+
+    def params(self) -> dict:
+        p = self.run_dir / "params.json"
+        return json.loads(p.read_text()) if p.exists() else {}
+
+    def log_metrics(self, metrics: t.Mapping[str, float], step: int) -> None:
+        if not self.enabled:
+            return
+        row = {"step": int(step), "time": time.time()}
+        row.update({k: float(v) for k, v in metrics.items()})
+        with open(self.run_dir / "metrics.jsonl", "a") as f:
+            f.write(json.dumps(row) + "\n")
+        if self._mlflow:
+            self._mlflow.log_metrics({k: float(v) for k, v in metrics.items()}, step)
+
+    def metrics(self) -> t.List[dict]:
+        p = self.run_dir / "metrics.jsonl"
+        if not p.exists():
+            return []
+        return [json.loads(line) for line in p.read_text().splitlines() if line]
+
+    def artifact_path(self, name: str) -> Path:
+        return self.artifacts_dir / name
