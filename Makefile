@@ -1,0 +1,27 @@
+# Capability twin of the reference Makefile (ref Makefile:1-28): test
+# runner plus operational helpers. The reference's mlflow/tensorboard/
+# dvc/prefect UI stubs map to the file-based tracking under runs/.
+
+.PHONY: test test-fast bench dryrun lint native clean
+
+test:
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/ -q -x --ignore=tests/test_wall_runner_env.py
+
+bench:
+	python bench.py
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		python __graft_entry__.py 8
+
+lint:
+	python -m flake8 torch_actor_critic_tpu tests || true
+
+native:
+	$(MAKE) -C torch_actor_critic_tpu/native
+
+clean:
+	rm -rf runs __pycache__ **/__pycache__

@@ -100,6 +100,14 @@ class SimpleCNN(nn.Module):
                 bias_init=torch_linear_bias_init(fan_in),
                 name=f"conv_{i}",
             )(x)
+            if 0 in x.shape[-3:]:
+                raise ValueError(
+                    f"SimpleCNN: conv_{i} (kernel {k}, stride {s}) reduced the "
+                    f"feature map to {x.shape[-3:]}; the input image is too "
+                    f"small for this conv geometry — shrink kernels/strides "
+                    f"(SACConfig.filters/kernel_sizes/strides) or use larger "
+                    f"frames."
+                )
             x = nn.relu(x)
         x = x.reshape(x.shape[:-3] + (-1,))
         x = Dense(self.dense_size)(x)

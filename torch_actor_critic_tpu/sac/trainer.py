@@ -64,11 +64,17 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
             act_dim=env.act_dim,
             hidden_sizes=config.hidden_sizes,
             act_limit=env.act_limit,
+            filters=config.filters,
+            kernel_sizes=config.kernel_sizes,
+            strides=config.strides,
             cnn_features=config.cnn_features,
             normalize_pixels=config.normalize_pixels,
         )
         critic = VisualDoubleCritic(
             hidden_sizes=config.hidden_sizes,
+            filters=config.filters,
+            kernel_sizes=config.kernel_sizes,
+            strides=config.strides,
             cnn_features=config.cnn_features,
             normalize_pixels=config.normalize_pixels,
             num_qs=config.num_qs,

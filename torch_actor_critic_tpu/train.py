@@ -72,7 +72,7 @@ def parse_arguments(argv=None) -> argparse.Namespace:
             parser.add_argument(
                 flag, type=lambda s: s.lower() in ("1", "true", "yes"), default=None
             )
-        elif f.name == "hidden_sizes":
+        elif isinstance(f.default, tuple):
             parser.add_argument(
                 flag, type=lambda s: tuple(int(x) for x in s.split(",")), default=None
             )

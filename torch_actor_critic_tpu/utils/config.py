@@ -53,7 +53,12 @@ class SACConfig:
     # reference exactly for return-parity runs.
     parity_pi_obs: bool = False
 
-    # Visual stack (ref main.py:63-90)
+    # Visual stack (ref main.py:63-90: filters/kernels/strides passed to
+    # the conv nets; defaults are the Atari-DQN trunk the reference
+    # hardcodes at main.py:65-67)
+    filters: t.Tuple[int, ...] = (32, 64, 64)
+    kernel_sizes: t.Tuple[int, ...] = (8, 4, 3)
+    strides: t.Tuple[int, ...] = (4, 2, 1)
     cnn_features: int = 1  # 1 == reference scalar-vision bottleneck
     normalize_pixels: bool = False
 
@@ -67,6 +72,13 @@ class SACConfig:
     # instead of a per-step accelerator round trip.
     host_actor: bool = True
 
+    def __post_init__(self):
+        if not (len(self.filters) == len(self.kernel_sizes) == len(self.strides)):
+            raise ValueError(
+                "filters/kernel_sizes/strides must have equal length, got "
+                f"{len(self.filters)}/{len(self.kernel_sizes)}/{len(self.strides)}"
+            )
+
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)
 
@@ -75,8 +87,9 @@ class SACConfig:
         raw = json.loads(s)
         field_names = {f.name for f in dataclasses.fields(cls)}
         kwargs = {k: v for k, v in raw.items() if k in field_names}
-        if "hidden_sizes" in kwargs:
-            kwargs["hidden_sizes"] = tuple(kwargs["hidden_sizes"])
+        for tup in ("hidden_sizes", "filters", "kernel_sizes", "strides"):
+            if tup in kwargs:
+                kwargs[tup] = tuple(kwargs[tup])
         return cls(**kwargs)
 
     def replace(self, **kwargs) -> "SACConfig":
