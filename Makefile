@@ -23,5 +23,8 @@ lint:
 native:
 	$(MAKE) -C torch_actor_critic_tpu/native
 
+native-asan:
+	$(MAKE) -C torch_actor_critic_tpu/native asan
+
 clean:
 	rm -rf runs __pycache__ **/__pycache__

@@ -1,0 +1,179 @@
+"""Vectorized env pool tests: sequential/parallel equivalence over the
+native shared-memory runtime, error propagation, and failure detection
+(capabilities absent in the reference — its per-step MPI recv deadlocks
+on a dead rank, ref ``sac/algorithm.py:262-271``; SURVEY.md §5).
+
+Parallel pools here use ``start_method='fork'`` so monkeypatched env
+factories propagate to workers and startup stays fast; workers only
+touch numpy, never jax compute, so forking the test process is safe.
+The spawn path (production default) differs only in process bootstrap.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from torch_actor_critic_tpu.envs.vec_env import (
+    ParallelEnvPool,
+    SequentialEnvPool,
+    make_env_pool,
+)
+from torch_actor_critic_tpu.native import load_runtime
+
+needs_native = pytest.mark.skipif(
+    load_runtime() is None, reason="native runtime unavailable"
+)
+
+OBS, ACT = 5, 3
+
+
+class FakeEnv:
+    """Deterministic env whose trajectory is a pure function of the seed
+    and the actions; raises on demand for error-path tests."""
+
+    def __init__(self, seed=0):
+        import jax
+
+        self.seed0 = seed or 0
+        self.act_dim = ACT
+        self.act_limit = 1.0
+        self.obs_spec = jax.ShapeDtypeStruct((OBS,), np.float32)
+        self._t = 0
+        self._state = None
+        self._rng = np.random.default_rng(self.seed0)
+
+    def reset(self, seed=None):
+        self._t = 0
+        base = self.seed0 if seed is None else seed
+        self._state = np.full(OBS, float(base % 97), np.float32)
+        return self._state.copy()
+
+    def step(self, action):
+        if float(action[0]) > 50.0:
+            raise ValueError("poison action")
+        self._t += 1
+        self._state = (self._state * 0.9 + float(action.sum())).astype(np.float32)
+        terminated = self._t % 13 == 0
+        truncated = False
+        return self._state.copy(), float(self._state[0]), terminated, truncated
+
+    def sample_action(self):
+        return self._rng.uniform(-1, 1, ACT).astype(np.float32)
+
+    def render(self):
+        pass
+
+    def close(self):
+        pass
+
+
+@pytest.fixture
+def fake_factory(monkeypatch):
+    import torch_actor_critic_tpu.envs.wrappers as wrappers_mod
+
+    monkeypatch.setattr(
+        wrappers_mod, "make_env", lambda name, seed=None, **kw: FakeEnv(seed)
+    )
+
+
+@needs_native
+def test_parallel_matches_sequential(fake_factory):
+    n = 4
+    seq = SequentialEnvPool("Fake-v0", n, base_seed=3)
+    par = ParallelEnvPool(
+        "Fake-v0", n, base_seed=3, timeout_s=30, start_method="fork"
+    )
+    try:
+        assert par.act_dim == ACT and par.obs_spec.shape == (OBS,)
+        seeds = [3 + 10000 * i for i in range(n)]
+        np.testing.assert_array_equal(seq.reset_all(seeds), par.reset_all(seeds))
+        rng = np.random.default_rng(0)
+        for _ in range(30):
+            a = rng.uniform(-1, 1, (n, ACT)).astype(np.float32)
+            os_, rs, ts, us = seq.step(a)
+            op_, rp, tp, up = par.step(a)
+            np.testing.assert_array_equal(os_, op_)
+            np.testing.assert_array_equal(rs, rp)
+            np.testing.assert_array_equal(ts, tp)
+            np.testing.assert_array_equal(us, up)
+        np.testing.assert_array_equal(
+            seq.reset_at(2, seed=99), par.reset_at(2, seed=99)
+        )
+        s1 = seq.step_at(2, np.ones(ACT, np.float32))
+        p1 = par.step_at(2, np.ones(ACT, np.float32))
+        np.testing.assert_array_equal(s1[0], p1[0])
+        assert s1[1:] == p1[1:]
+    finally:
+        par.close()
+        seq.close()
+
+
+@needs_native
+def test_worker_env_exception_is_reported(fake_factory):
+    par = ParallelEnvPool(
+        "Fake-v0", 2, base_seed=0, timeout_s=30, start_method="fork"
+    )
+    try:
+        par.reset_all()
+        poison = np.zeros((2, ACT), np.float32)
+        poison[1, 0] = 100.0  # worker 1 raises
+        with pytest.raises(RuntimeError, match="poison action"):
+            par.step(poison)
+    finally:
+        par.close()
+
+
+@needs_native
+def test_dead_worker_is_diagnosed(fake_factory):
+    par = ParallelEnvPool(
+        "Fake-v0", 2, base_seed=0, timeout_s=3, start_method="fork"
+    )
+    try:
+        par.reset_all()
+        os.kill(par._procs[1].pid, signal.SIGKILL)
+        time.sleep(0.2)
+        with pytest.raises(RuntimeError, match="worker 1"):
+            par.step(np.zeros((2, ACT), np.float32))
+    finally:
+        par.close()
+
+
+def test_make_env_pool_fallback(fake_factory):
+    pool = make_env_pool("Fake-v0", 1, parallel=True)
+    assert isinstance(pool, SequentialEnvPool)  # n==1 never forks workers
+    pool.close()
+
+
+@needs_native
+def test_trainer_with_parallel_envs(fake_factory, tmp_path):
+    """End-to-end training over the parallel pool on a 2-device mesh."""
+    from torch_actor_critic_tpu.parallel import make_mesh
+    from torch_actor_critic_tpu.sac.trainer import Trainer
+    from torch_actor_critic_tpu.utils.config import SACConfig
+
+    cfg = SACConfig(
+        hidden_sizes=(16, 16),
+        batch_size=8,
+        epochs=1,
+        steps_per_epoch=30,
+        start_steps=10,
+        update_after=10,
+        update_every=10,
+        buffer_size=500,
+        max_ep_len=20,
+        parallel_envs=True,
+        env_timeout_s=30.0,
+        env_start_method="fork",
+    )
+    trainer = Trainer("Fake-v0", cfg, mesh=make_mesh(dp=2))
+    # fork-based pool for CI speed (see module docstring)
+    assert isinstance(trainer.pool, ParallelEnvPool) or load_runtime() is None
+    try:
+        metrics = trainer.train()
+        assert np.isfinite(metrics["loss_q"])
+        assert metrics["episode_length"] > 0
+    finally:
+        trainer.close()

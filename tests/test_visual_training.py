@@ -63,11 +63,13 @@ class FakeVisualEnv:
 
 @pytest.fixture
 def visual_trainer(monkeypatch, tmp_path):
-    # Route the trainer's env factory to the fake env.
+    # Route the env factory to the fake env (the pool resolves make_env
+    # from the wrappers module).
+    import torch_actor_critic_tpu.envs.wrappers as wrappers_mod
     import torch_actor_critic_tpu.sac.trainer as trainer_mod
 
     monkeypatch.setattr(
-        trainer_mod, "make_env", lambda name, seed=None: FakeVisualEnv(seed or 0)
+        wrappers_mod, "make_env", lambda name, seed=None: FakeVisualEnv(seed or 0)
     )
     monkeypatch.setattr(trainer_mod, "is_visual_env", lambda name: True)
     cfg = SACConfig(

@@ -1,0 +1,546 @@
+"""Vectorized host environment pools.
+
+dm_control/MuJoCo physics is single-threaded C driven from Python, so a
+lockstep env batch stepped sequentially costs ``n x step_time`` on one
+core — the host becomes the bottleneck long before the chip does
+(SURVEY.md §7 hard part (e)). The reference sidesteps this by giving
+each MPI rank its own process *and* its own learner replica (ref
+``sac/mpi.py:10-34``); here the learner is the TPU mesh, so the host
+side gets its own parallelism instead:
+
+- :class:`SequentialEnvPool` — in-process lockstep batch (no native
+  dependency; the default, and the fallback).
+- :class:`ParallelEnvPool` — one **worker process per env** stepping
+  truly in parallel. The hot path is native: commands and acks are
+  int32 words in POSIX shared memory synchronized by futex wait/wake
+  (``native/tac_runtime.cpp``); actions and observations cross process
+  boundaries by being written in place as rows of the batched
+  shared-memory arrays the trainer consumes. No pipes, no pickling, no
+  per-step allocations. Worker startup/handshake (env construction,
+  spec exchange) uses a one-time ``multiprocessing`` pipe off the hot
+  path.
+
+Both expose one protocol:
+
+- ``obs_spec`` / ``act_dim`` / ``act_limit`` / ``n``
+- ``reset_all(seeds) -> stacked obs``; ``reset_at(i, seed) -> obs_i``
+- ``step(actions) -> (stacked obs, rewards, terminated, truncated)``
+- ``step_at(i, action)``, ``sample_actions()``, ``render_at(i)``,
+  ``close()``
+
+Failure detection (absent in the reference, whose per-step
+``comm.recv`` deadlocks forever on a dead rank — ref
+``sac/algorithm.py:262-271``, SURVEY.md §5): every native wait has a
+timeout; on expiry the pool checks worker liveness and raises a
+diagnosed ``RuntimeError``. A worker whose env raises mid-step reports
+the traceback through its pipe instead of hanging the barrier. Workers
+watch their parent pid and exit if orphaned.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import multiprocessing as mp
+import os
+import typing as t
+from multiprocessing import shared_memory
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+CMD_STEP = 1
+CMD_RESET = 2
+CMD_RENDER = 3
+CMD_CLOSE = 4
+
+# int32 words per worker in the control block (64 B: one cache line, no
+# false sharing between workers' futex words).
+CTRL_STRIDE = 16
+_SEQ, _CMD, _ACK, _ERR = 0, 1, 2, 3
+
+_ALIGN = 64
+
+
+def _obs_leaves(obs) -> list:
+    """Deterministic leaf order for the one structured obs type.
+
+    Local structural handling instead of jax pytree flattening so env
+    worker processes never need jax on the hot path.
+    """
+    from torch_actor_critic_tpu.core.types import MultiObservation
+
+    if isinstance(obs, MultiObservation):
+        return [obs.features, obs.frame]
+    return [obs]
+
+
+def _rebuild_obs(kind: str, leaves: list):
+    if kind == "multiobs":
+        from torch_actor_critic_tpu.core.types import MultiObservation
+
+        return MultiObservation(features=leaves[0], frame=leaves[1])
+    return leaves[0]
+
+
+def _spec_message(env) -> dict:
+    """Picklable description of an env's interface (worker -> parent)."""
+    from torch_actor_critic_tpu.core.types import MultiObservation
+
+    spec = env.obs_spec
+    kind = "multiobs" if isinstance(spec, MultiObservation) else "array"
+    leaves = [
+        (tuple(s.shape), np.dtype(s.dtype).str) for s in _obs_leaves(spec)
+    ]
+    return {
+        "kind": kind,
+        "leaves": leaves,
+        "act_dim": int(env.act_dim),
+        "act_limit": float(env.act_limit),
+    }
+
+
+def _spec_pytree(msg: dict):
+    import jax
+
+    leaves = [
+        jax.ShapeDtypeStruct(shape, np.dtype(dt)) for shape, dt in msg["leaves"]
+    ]
+    return _rebuild_obs(msg["kind"], leaves)
+
+
+def _layout(n: int, act_dim: int, leaves: t.Sequence[t.Tuple[tuple, str]]):
+    """(offset, shape, dtype) table for the single shared-memory block."""
+    fields: dict = {}
+    off = n * CTRL_STRIDE * 4  # control block first
+    for name, shape, dtype in [
+        ("actions", (n, act_dim), "<f4"),
+        ("rewards", (n,), "<f4"),
+        ("terminated", (n,), "|u1"),
+        ("truncated", (n,), "|u1"),
+        ("seeds", (n,), "<i8"),
+        *[
+            (f"obs_{k}", (n, *shape), dt)
+            for k, (shape, dt) in enumerate(leaves)
+        ],
+    ]:
+        off = (off + _ALIGN - 1) // _ALIGN * _ALIGN
+        fields[name] = (off, shape, dtype)
+        off += int(np.prod(shape)) * np.dtype(dtype).itemsize
+    return fields, off
+
+
+def _views(buf, n: int, fields: dict):
+    """ctrl int32 view + named np views over one shm buffer."""
+    ctrl = np.frombuffer(buf, dtype=np.int32, count=n * CTRL_STRIDE)
+    data = {
+        name: np.frombuffer(
+            buf, dtype=np.dtype(dt), count=int(np.prod(shape)), offset=off
+        ).reshape(shape)
+        for name, (off, shape, dt) in fields.items()
+    }
+    return ctrl, data
+
+
+class SequentialEnvPool:
+    """In-process lockstep batch of envs — the no-dependency baseline
+    (equivalent host cost to the reference's one-env-per-rank loop,
+    ref ``sac/algorithm.py:226-260``, minus the process parallelism)."""
+
+    def __init__(
+        self,
+        env_name: str,
+        n: int,
+        base_seed: int = 0,
+        seed_stride: int = 10000,
+        **_,
+    ):
+        from torch_actor_critic_tpu.envs.wrappers import make_env
+
+        self.n = n
+        self.envs = [
+            make_env(env_name, seed=base_seed + seed_stride * i)
+            for i in range(n)
+        ]
+        e0 = self.envs[0]
+        self.obs_spec, self.act_dim, self.act_limit = (
+            e0.obs_spec,
+            e0.act_dim,
+            e0.act_limit,
+        )
+
+    def _stack(self, rows: list):
+        leaf_rows = [_obs_leaves(r) for r in rows]
+        kind = "multiobs" if len(leaf_rows[0]) == 2 else "array"
+        return _rebuild_obs(
+            kind,
+            [np.stack([lr[k] for lr in leaf_rows]) for k in range(len(leaf_rows[0]))],
+        )
+
+    def reset_all(self, seeds: t.Sequence[int | None] | None = None):
+        seeds = seeds or [None] * self.n
+        return self._stack([e.reset(seed=s) for e, s in zip(self.envs, seeds)])
+
+    def reset_at(self, i: int, seed: int | None = None):
+        return self.envs[i].reset(seed=seed)
+
+    def step(self, actions: np.ndarray):
+        out = [e.step(a) for e, a in zip(self.envs, actions)]
+        obs = self._stack([o[0] for o in out])
+        r = np.asarray([o[1] for o in out], np.float32)
+        term = np.asarray([o[2] for o in out], bool)
+        trunc = np.asarray([o[3] for o in out], bool)
+        return obs, r, term, trunc
+
+    def step_at(self, i: int, action: np.ndarray):
+        return self.envs[i].step(action)
+
+    def sample_actions(self) -> np.ndarray:
+        return np.stack([e.sample_action() for e in self.envs])
+
+    def render_at(self, i: int):
+        return self.envs[i].render()
+
+    def close(self):
+        for e in self.envs:
+            e.close()
+
+
+def _serve(lib, idx: int, env, conn, shm, n: int, fields: dict, parent_pid: int):
+    """Worker command loop. All shm views live in THIS frame so they are
+    released (np arrays holding buffer exports die with the frame) before
+    the caller closes the mapping."""
+    ctrl, data = _views(shm.buf, n, fields)
+    obs_views = [data[f"obs_{k}"] for k in range(len(data) - 5)]
+    base = ctrl.ctypes.data
+
+    def addr(word):
+        return base + (idx * CTRL_STRIDE + word) * 4
+
+    conn.send(("ready", None))
+    last = 0
+    while True:
+        # 1s wait slices so an orphaned worker notices parent death.
+        if lib.tac_wait_ne(addr(_SEQ), last, 1000) != 0:
+            if os.getppid() != parent_pid:
+                logger.warning("env worker %d orphaned; exiting", idx)
+                return
+            continue
+        last = int(lib.tac_load(addr(_SEQ)))
+        cmd = int(ctrl[idx * CTRL_STRIDE + _CMD])
+        ctrl[idx * CTRL_STRIDE + _ERR] = 0
+        stop = False
+        try:
+            if cmd == CMD_STEP:
+                obs, r, term, trunc = env.step(data["actions"][idx].copy())
+                for view, leaf in zip(obs_views, _obs_leaves(obs)):
+                    view[idx] = leaf
+                data["rewards"][idx] = r
+                data["terminated"][idx] = term
+                data["truncated"][idx] = trunc
+            elif cmd == CMD_RESET:
+                s = int(data["seeds"][idx])
+                obs = env.reset(seed=None if s < 0 else s)
+                for view, leaf in zip(obs_views, _obs_leaves(obs)):
+                    view[idx] = leaf
+            elif cmd == CMD_RENDER:
+                env.render()
+            elif cmd == CMD_CLOSE:
+                stop = True
+        except Exception:  # noqa: BLE001 — report, don't hang the barrier
+            import traceback
+
+            ctrl[idx * CTRL_STRIDE + _ERR] = 1
+            try:
+                conn.send(("error", traceback.format_exc()))
+            except OSError:  # pragma: no cover
+                pass
+        lib.tac_store_wake(addr(_ACK), last)
+        if stop:
+            return
+
+
+def _worker_main(
+    idx: int,
+    env_name: str,
+    seed: int,
+    conn,
+    parent_pid: int,
+):
+    """Env worker: build env, handshake spec, then serve futex commands."""
+    from torch_actor_critic_tpu.native import load_runtime
+
+    shm = None
+    env = None
+    try:
+        from torch_actor_critic_tpu.envs.wrappers import make_env
+
+        lib = load_runtime()
+        if lib is None:  # parent checked before spawning; defensive
+            conn.send(("error", "native runtime unavailable in worker"))
+            return
+        env = make_env(env_name, seed=seed)
+        conn.send(("spec", _spec_message(env)))
+        shm_name, n, fields = conn.recv()
+        shm = shared_memory.SharedMemory(name=shm_name)
+        _serve(lib, idx, env, conn, shm, n, fields, parent_pid)
+    finally:
+        if env is not None:
+            env.close()
+        if shm is not None:
+            shm.close()
+        conn.close()
+
+
+class ParallelEnvPool:
+    """One worker process per env over shared memory + futex sync."""
+
+    def __init__(
+        self,
+        env_name: str,
+        n: int,
+        base_seed: int = 0,
+        seed_stride: int = 10000,
+        timeout_s: float = 120.0,
+        start_method: str = "spawn",
+    ):
+        from torch_actor_critic_tpu.native import load_runtime
+
+        lib = load_runtime()
+        if lib is None:
+            raise RuntimeError(
+                "ParallelEnvPool needs the native runtime "
+                "(torch_actor_critic_tpu/native); build with `make native` "
+                "or use SequentialEnvPool."
+            )
+        self._lib = lib
+        self.n = n
+        self.env_name = env_name
+        self.timeout_ms = int(timeout_s * 1000)
+        # spawn (default): workers never inherit the parent's live TPU
+        # client/jax state across fork — env construction cost is paid
+        # once at startup, in parallel across workers.
+        ctx = mp.get_context(start_method)
+        self._conns, self._procs = [], []
+        for i in range(n):
+            parent_conn, child_conn = ctx.Pipe()
+            p = ctx.Process(
+                target=_worker_main,
+                args=(
+                    i,
+                    env_name,
+                    base_seed + seed_stride * i,
+                    child_conn,
+                    os.getpid(),
+                ),
+                daemon=True,
+                name=f"tac-env-{i}",
+            )
+            p.start()
+            child_conn.close()
+            self._conns.append(parent_conn)
+            self._procs.append(p)
+
+        try:
+            specs = [self._recv(i, "spec") for i in range(n)]
+            msg = specs[0]
+            self.act_dim = msg["act_dim"]
+            self.act_limit = msg["act_limit"]
+            self.obs_spec = _spec_pytree(msg)
+            self._kind = msg["kind"]
+            self._rng = np.random.default_rng(base_seed)
+
+            fields, size = _layout(n, self.act_dim, msg["leaves"])
+            self._shm = shared_memory.SharedMemory(create=True, size=size)
+            self._ctrl, self._data = _views(self._shm.buf, n, fields)
+            self._obs_views = [
+                self._data[f"obs_{k}"] for k in range(len(msg["leaves"]))
+            ]
+            self._ctrl_base = self._ctrl.ctypes.data
+            for conn in self._conns:
+                conn.send((self._shm.name, n, fields))
+            for i in range(n):
+                self._recv(i, "ready")
+        except Exception:
+            # A failed handshake must not strand parked workers (close()
+            # is not reachable yet): tear everything down, then re-raise.
+            for p in self._procs:
+                if p.is_alive():
+                    p.terminate()
+                p.join(timeout=2)
+            for conn in self._conns:
+                conn.close()
+            if hasattr(self, "_shm"):
+                try:
+                    del self._ctrl, self._data, self._obs_views
+                except AttributeError:
+                    pass
+                self._shm.close()
+                self._shm.unlink()
+            raise
+        self._closed = False
+        self._finalizer = atexit.register(self.close)
+
+    # ------------------------------------------------------------ plumbing
+
+    def _recv(self, i: int, expect: str):
+        if not self._conns[i].poll(self.timeout_ms / 1000):
+            raise RuntimeError(
+                f"env worker {i} did not respond during handshake "
+                f"(alive={self._procs[i].is_alive()})"
+            )
+        tag, payload = self._conns[i].recv()
+        if tag == "error":
+            raise RuntimeError(f"env worker {i} failed:\n{payload}")
+        assert tag == expect, (tag, expect)
+        return payload
+
+    def _addr(self, i: int, word: int) -> int:
+        return self._ctrl_base + (i * CTRL_STRIDE + word) * 4
+
+    def _dispatch(self, workers: t.Sequence[int], cmd: int):
+        for i in workers:
+            self._ctrl[i * CTRL_STRIDE + _CMD] = cmd
+            seq = int(self._ctrl[i * CTRL_STRIDE + _SEQ]) + 1
+            self._lib.tac_store_wake(self._addr(i, _SEQ), seq)
+
+    def _diagnose(self, i: int) -> t.NoReturn:
+        alive = self._procs[i].is_alive()
+        detail = ""
+        try:
+            if self._conns[i].poll(0):
+                tag, payload = self._conns[i].recv()
+                if tag == "error":
+                    detail = f"\nworker traceback:\n{payload}"
+        except (EOFError, OSError):  # pipe died with the worker
+            pass
+        raise RuntimeError(
+            f"env worker {i} {'hung' if alive else 'died'} "
+            f"(env={self.env_name}, timeout={self.timeout_ms}ms){detail}"
+        )
+
+    def _wait(self, workers: t.Sequence[int]):
+        if list(workers) == list(range(self.n)):
+            r = self._lib.tac_wait_all_eq(
+                self._addr(0, _ACK),
+                self._addr(0, _SEQ),
+                self.n,
+                CTRL_STRIDE,
+                self.timeout_ms,
+            )
+            if r != 0:
+                self._diagnose(-r - 1)
+        else:
+            for i in workers:
+                want = int(self._ctrl[i * CTRL_STRIDE + _SEQ])
+                while True:
+                    got = int(self._lib.tac_load(self._addr(i, _ACK)))
+                    if got == want:
+                        break
+                    if (
+                        self._lib.tac_wait_ne(
+                            self._addr(i, _ACK), got, self.timeout_ms
+                        )
+                        != 0
+                    ):
+                        self._diagnose(i)
+        for i in workers:
+            if self._ctrl[i * CTRL_STRIDE + _ERR]:
+                self._diagnose(i)
+
+    def _obs_stacked(self):
+        return _rebuild_obs(self._kind, [np.array(v) for v in self._obs_views])
+
+    def _obs_row(self, i: int):
+        return _rebuild_obs(self._kind, [np.array(v[i]) for v in self._obs_views])
+
+    # ------------------------------------------------------------- protocol
+
+    def reset_all(self, seeds: t.Sequence[int | None] | None = None):
+        seeds = seeds or [None] * self.n
+        self._data["seeds"][:] = [-1 if s is None else s for s in seeds]
+        self._dispatch(range(self.n), CMD_RESET)
+        self._wait(range(self.n))
+        return self._obs_stacked()
+
+    def reset_at(self, i: int, seed: int | None = None):
+        self._data["seeds"][i] = -1 if seed is None else seed
+        self._dispatch([i], CMD_RESET)
+        self._wait([i])
+        return self._obs_row(i)
+
+    def step(self, actions: np.ndarray):
+        self._data["actions"][:] = actions
+        self._dispatch(range(self.n), CMD_STEP)
+        self._wait(range(self.n))
+        return (
+            self._obs_stacked(),
+            np.array(self._data["rewards"]),
+            np.array(self._data["terminated"], bool),
+            np.array(self._data["truncated"], bool),
+        )
+
+    def step_at(self, i: int, action: np.ndarray):
+        self._data["actions"][i] = action
+        self._dispatch([i], CMD_STEP)
+        self._wait([i])
+        return (
+            self._obs_row(i),
+            float(self._data["rewards"][i]),
+            bool(self._data["terminated"][i]),
+            bool(self._data["truncated"][i]),
+        )
+
+    def sample_actions(self) -> np.ndarray:
+        """Uniform warmup actions (ref ``env.action_space.sample()``,
+        ``sac/algorithm.py:228``), drawn parent-side: these envs all
+        have symmetric bounded Box spaces."""
+        return self._rng.uniform(
+            -self.act_limit, self.act_limit, (self.n, self.act_dim)
+        ).astype(np.float32)
+
+    def render_at(self, i: int):
+        self._dispatch([i], CMD_RENDER)
+        self._wait([i])
+
+    def close(self):
+        if getattr(self, "_closed", True):
+            return
+        self._closed = True
+        atexit.unregister(self.close)
+        try:
+            live = [i for i, p in enumerate(self._procs) if p.is_alive()]
+            self._dispatch(live, CMD_CLOSE)
+            for p in self._procs:
+                p.join(timeout=2)
+                if p.is_alive():
+                    p.terminate()
+        finally:
+            del self._ctrl, self._data, self._obs_views
+            self._shm.close()
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:  # pragma: no cover
+                pass
+
+
+def make_env_pool(
+    env_name: str,
+    n: int,
+    base_seed: int = 0,
+    parallel: bool = False,
+    **kwargs,
+):
+    """Pool factory; falls back to sequential when the native runtime is
+    unavailable or the pool has a single env (process overhead > win)."""
+    if parallel and n > 1:
+        from torch_actor_critic_tpu.native import load_runtime
+
+        if load_runtime() is not None:
+            return ParallelEnvPool(env_name, n, base_seed=base_seed, **kwargs)
+        logger.warning(
+            "parallel_envs requested but native runtime unavailable; "
+            "using SequentialEnvPool"
+        )
+    return SequentialEnvPool(env_name, n, base_seed=base_seed, **kwargs)

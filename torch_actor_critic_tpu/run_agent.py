@@ -60,13 +60,16 @@ def main(argv=None):
     trainer = Trainer(
         env_name, config, mesh=make_mesh(dp=1), checkpointer=checkpointer
     )
-    trainer.restore(include_buffer=False)
-    logger.info("evaluating run %s on %s", args.run, env_name)
-    metrics = trainer.evaluate(
-        episodes=args.episodes,
-        deterministic=args.deterministic,
-        render=args.render,
-    )
+    try:
+        trainer.restore(include_buffer=False)
+        logger.info("evaluating run %s on %s", args.run, env_name)
+        metrics = trainer.evaluate(
+            episodes=args.episodes,
+            deterministic=args.deterministic,
+            render=args.render,
+        )
+    finally:
+        trainer.close()
     logger.info("eval metrics: %s", metrics)
     print(json.dumps(metrics))
     return metrics

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torch_actor_critic_tpu.core.types import Batch, MultiObservation
-from torch_actor_critic_tpu.envs import make_env
+from torch_actor_critic_tpu.envs.vec_env import make_env_pool
 from torch_actor_critic_tpu.envs.wrappers import is_visual_env
 from torch_actor_critic_tpu.models import Actor, DoubleCritic, VisualActor, VisualDoubleCritic
 from torch_actor_critic_tpu.parallel import (
@@ -94,6 +94,17 @@ def _stack_obs(obs_list: t.Sequence) -> t.Any:
     return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *obs_list)
 
 
+def _row(tree: t.Any, i: int) -> t.Any:
+    """Copy of row ``i`` of a stacked observation pytree. A copy, not a
+    view: staged transitions must survive in-place writes to the stacked
+    array (episode resets overwrite rows)."""
+    return jax.tree_util.tree_map(lambda x: np.array(x[i]), tree)
+
+
+def _set_row(tree: t.Any, i: int, value: t.Any) -> None:
+    jax.tree_util.tree_map(lambda dst, src: dst.__setitem__(i, src), tree, value)
+
+
 class Trainer:
     """End-to-end SAC training over a device mesh.
 
@@ -119,18 +130,25 @@ class Trainer:
         self.tracker = tracker
         self.checkpointer = checkpointer
 
-        self.envs = [
-            make_env(env_name, seed=seed + 10000 * i) for i in range(self.n_envs)
-        ]
-        env0 = self.envs[0]
+        # One env per dp mesh slice, stepped as a pool: sequential
+        # in-process by default, parallel worker processes over the
+        # native shared-memory runtime with `parallel_envs`.
+        self.pool = make_env_pool(
+            env_name,
+            self.n_envs,
+            base_seed=seed,
+            parallel=self.config.parallel_envs,
+            timeout_s=self.config.env_timeout_s,
+            start_method=self.config.env_start_method,
+        )
         self.visual = is_visual_env(env_name)
         if self.config.normalize_observations and not self.visual:
-            self.normalizer = WelfordNormalizer(env0.obs_spec.shape[0])
+            self.normalizer = WelfordNormalizer(self.pool.obs_spec.shape[0])
         else:
             self.normalizer = IdentityNormalizer()
 
-        actor_def, critic_def = build_models(self.config, env0)
-        self.sac = SAC(self.config, actor_def, critic_def, env0.act_dim)
+        actor_def, critic_def = build_models(self.config, self.pool)
+        self.sac = SAC(self.config, actor_def, critic_def, self.pool.act_dim)
         self.dp = DataParallelSAC(self.sac, self.mesh)
 
         # Actor/learner split (Podracer-style): action selection runs on
@@ -168,12 +186,12 @@ class Trainer:
             key = jax.device_put(key, self._host_device)
         self._act_key, init_key = jax.random.split(key)
         example_obs = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), env0.obs_spec
+            lambda s: jnp.zeros(s.shape, s.dtype), self.pool.obs_spec
         )
         self.state = self.dp.init_state(init_key, example_obs)
         per_dev_capacity = max(self.config.buffer_size // self.n_envs, 1)
         self.buffer = init_sharded_buffer(
-            per_dev_capacity, env0.obs_spec, env0.act_dim, self.mesh
+            per_dev_capacity, self.pool.obs_spec, self.pool.act_dim, self.mesh
         )
         self.start_epoch = 0
 
@@ -184,8 +202,7 @@ class Trainer:
             return obs
         return self.normalizer.normalize(obs, update=update)
 
-    def _policy_actions(self, obs_list, deterministic=False) -> np.ndarray:
-        obs_batch = _stack_obs(obs_list)
+    def _policy_actions(self, obs_batch, deterministic=False) -> np.ndarray:
         self._act_key, sub = jax.random.split(self._act_key)
         if self.config.host_actor:
             if self._host_params is None:
@@ -237,10 +254,10 @@ class Trainer:
         cfg = self.config
         n = self.n_envs
 
-        obs = [
-            self._normalize(env.reset(seed=self.seed + 10000 * i), update=True)
-            for i, env in enumerate(self.envs)
-        ]
+        obs = self._normalize(
+            self.pool.reset_all([self.seed + 10000 * i for i in range(n)]),
+            update=True,
+        )
         ep_ret = np.zeros(n)
         ep_len = np.zeros(n, np.int64)
         staging: t.List[list] = [[] for _ in range(n)]
@@ -270,36 +287,47 @@ class Trainer:
             for t_ in range(cfg.steps_per_epoch):
                 # --- action selection (ref :227-236) ---
                 if step < cfg.start_steps:
-                    actions = np.stack([env.sample_action() for env in self.envs])
+                    actions = self.pool.sample_actions()
                 else:
                     actions = self._policy_actions(obs)
 
-                # --- env step + bookkeeping (ref :238-260) ---
+                # --- env step (one lockstep pool dispatch) + bookkeeping
+                # (ref :238-260) ---
                 epoch_ended = t_ == cfg.steps_per_epoch - 1
-                for i, env in enumerate(self.envs):
-                    next_obs, reward, terminated, truncated = env.step(actions[i])
-                    next_obs = self._normalize(next_obs, update=True)
-                    ep_len[i] += 1
-                    ep_ret[i] += reward
+                next_obs, rewards, terms, truncs = self.pool.step(actions)
+                next_obs = self._normalize(next_obs, update=True)
+                ep_len += 1
+                ep_ret += rewards
+                for i in range(n):
                     # max_ep_len bypass (ref :241): an episode cut by the
                     # length cap is a truncation — do not zero the
                     # bootstrap.
                     hit_cap = ep_len[i] >= cfg.max_ep_len
-                    done_for_buffer = float(terminated and not hit_cap)
+                    done_for_buffer = float(terms[i] and not hit_cap)
                     staging[i].append(
-                        (obs[i], actions[i], reward, next_obs, done_for_buffer)
+                        (
+                            _row(obs, i),
+                            actions[i],
+                            rewards[i],
+                            _row(next_obs, i),
+                            done_for_buffer,
+                        )
                     )
-                    obs[i] = next_obs
 
                     if render and i == 0 and is_coordinator():
-                        env.render()
+                        self.pool.render_at(0)
 
-                    if terminated or truncated or hit_cap or epoch_ended:
+                    if terms[i] or truncs[i] or hit_cap or epoch_ended:
                         episode_rewards.append(float(ep_ret[i]))
                         episode_lengths.append(int(ep_len[i]))
-                        obs[i] = self._normalize(env.reset(), update=True)
+                        _set_row(
+                            next_obs,
+                            i,
+                            self._normalize(self.pool.reset_at(i), update=True),
+                        )
                         ep_ret[i] = 0.0
                         ep_len[i] = 0
+                obs = next_obs
                 env_steps_this_epoch += n
 
                 # --- device window: push or push+update (ref :273-283) ---
@@ -358,6 +386,10 @@ class Trainer:
             self.checkpointer.wait()
         return last_metrics
 
+    def close(self):
+        """Release env pool resources (worker processes, shared memory)."""
+        self.pool.close()
+
     # ------------------------------------------------------------- resume
 
     def restore(self, epoch: int | None = None, include_buffer: bool = True) -> int:
@@ -389,21 +421,21 @@ class Trainer:
         self, episodes: int = 10, deterministic: bool = True, render: bool = False
     ) -> dict:
         """Rollout loop (ref ``run_agent.run_agent``, ``run_agent.py:19-48``)."""
-        env = self.envs[0]
         returns, lengths = [], []
         for _ in range(episodes):
-            o = self._normalize(env.reset(), update=False)
+            o = self._normalize(self.pool.reset_at(0), update=False)
             done = False
             ret, length = 0.0, 0
             while not done and length < self.config.max_ep_len:
-                a = self._policy_actions([o], deterministic=deterministic)[0]
-                o, r, terminated, truncated = env.step(a)
+                batched = jax.tree_util.tree_map(lambda x: x[None], o)
+                a = self._policy_actions(batched, deterministic=deterministic)[0]
+                o, r, terminated, truncated = self.pool.step_at(0, a)
                 o = self._normalize(o, update=False)
                 ret += r
                 length += 1
                 done = terminated or truncated
                 if render:
-                    env.render()
+                    self.pool.render_at(0)
             returns.append(ret)
             lengths.append(length)
         return {

@@ -58,6 +58,14 @@ def parse_arguments(argv=None) -> argparse.Namespace:
         help="Data-parallel width (default: all visible devices)",
     )
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--profile",
+        metavar="DIR",
+        default=None,
+        help="Capture a jax.profiler trace of the run into DIR (view with "
+        "tensorboard/xprof). Profile short runs: --epochs 2 --steps-per-epoch "
+        "500. The reference has no profiling at all (SURVEY.md §5).",
+    )
     parser.add_argument("--runs-root", default="runs", help="Tracking root directory")
     parser.add_argument(
         "--no-save-buffer",
@@ -142,7 +150,17 @@ def main(argv=None):
     logger.info(
         "training %s on mesh %s (run %s)", env_name, dict(mesh.shape), tracker.run_id
     )
-    metrics = trainer.train(render=args.render)
+    try:
+        if args.profile:
+            import jax
+
+            with jax.profiler.trace(args.profile):
+                metrics = trainer.train(render=args.render)
+            logger.info("profiler trace written to %s", args.profile)
+        else:
+            metrics = trainer.train(render=args.render)
+    finally:
+        trainer.close()
     logger.info("final metrics: %s", metrics)
     return metrics
 

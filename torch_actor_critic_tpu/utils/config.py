@@ -72,6 +72,22 @@ class SACConfig:
     # instead of a per-step accelerator round trip.
     host_actor: bool = True
 
+    # Step the host env batch in parallel worker processes over the
+    # native shared-memory runtime (envs/vec_env.py + native/). False =
+    # in-process sequential stepping. The reference gets env parallelism
+    # only as a side effect of whole-trainer MPI replication (ref
+    # sac/mpi.py:10-34); here the host physics scales independently of
+    # the learner mesh.
+    parallel_envs: bool = False
+    # Native-pool wait timeout: a worker that exceeds it is diagnosed
+    # (hung vs dead) and surfaced as an error instead of deadlocking the
+    # run (cf. the reference's per-step recv deadlock, SURVEY.md §5).
+    env_timeout_s: float = 120.0
+    # Worker bootstrap: "spawn" (default; workers never inherit live
+    # TPU-client/jax state) or "fork" (fast startup; safe when envs are
+    # pure numpy).
+    env_start_method: str = "spawn"
+
     def __post_init__(self):
         if not (len(self.filters) == len(self.kernel_sizes) == len(self.strides)):
             raise ValueError(

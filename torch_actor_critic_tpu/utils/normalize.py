@@ -31,12 +31,21 @@ class WelfordNormalizer:
         self.eps = eps
 
     def normalize(self, x: np.ndarray, update: bool = True) -> np.ndarray:
+        """Accepts one observation ``(dim,)`` or a lockstep batch
+        ``(n, dim)`` (the vectorized env pool path). The batched update
+        is Chan's parallel merge, which reduces exactly to Welford's
+        single-sample recurrence at n=1."""
         x = np.asarray(x, np.float64)
         if update:
-            self.count += 1
-            delta = x - self.mean
-            self.mean += delta / self.count
-            self.m2 += delta * (x - self.mean)
+            xb = x if x.ndim == 2 else x[None]
+            n = xb.shape[0]
+            b_mean = xb.mean(axis=0)
+            b_m2 = ((xb - b_mean) ** 2).sum(axis=0)
+            delta = b_mean - self.mean
+            total = self.count + n
+            self.mean = self.mean + delta * n / total
+            self.m2 = self.m2 + b_m2 + delta**2 * self.count * n / total
+            self.count = total
         var = self.m2 / max(self.count, 1)
         return ((x - self.mean) / np.sqrt(var + self.eps)).astype(np.float32)
 
