@@ -1,0 +1,187 @@
+"""Attention stack: blockwise/flash kernels, sequence models, ring
+context parallelism.
+
+All extension capability (the reference has no attention or sequence
+axis — SURVEY.md §5), tested the way the distributed suite tests DP:
+exact numerics against a dense reference, and real collective semantics
+on the 8-virtual-device CPU mesh from ``conftest.py``. The Pallas
+kernel runs in interpreter mode here (same kernel code path the TPU
+compiles).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_actor_critic_tpu.models import SequenceActor, SequenceDoubleCritic
+from torch_actor_critic_tpu.ops.attention import (
+    blockwise_attention,
+    flash_attention,
+    reference_attention,
+)
+from torch_actor_critic_tpu.parallel import make_mesh
+from torch_actor_critic_tpu.parallel.context import (
+    context_parallel_actor_step,
+    ring_attention,
+)
+from jax.sharding import PartitionSpec as P
+
+
+def qkv(seed, b=2, h=2, t=32, d=16):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    shape = (b, h, t, d)
+    return tuple(jax.random.normal(k, shape) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block_k", [8, 16, 13])  # 13: pad-tail path
+def test_blockwise_matches_reference(causal, block_k):
+    q, k, v = qkv(0, t=40)
+    expected = reference_attention(q, k, v, causal=causal)
+    got = blockwise_attention(q, k, v, causal=causal, block_k=block_k)
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_matches_reference(causal):
+    q, k, v = qkv(1, t=32, d=16)
+    expected = reference_attention(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal, 8, 8, True)  # interpret mode
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_flash_rejects_ragged_lengths():
+    q, k, v = qkv(20, t=20)  # 20 % 8 != 0
+    with pytest.raises(ValueError, match="ragged"):
+        flash_attention(q, k, v, False, 8, 8, True)
+
+
+def test_flash_pads_head_dim():
+    # d=16 is not lane-aligned; the wrapper zero-pads to 128 and slices.
+    q, k, v = qkv(21, t=16, d=16)
+    expected = reference_attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, True, 8, 8, True)
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_flash_gradients_match_reference():
+    q, k, v = qkv(2, b=1, h=1, t=16, d=8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, 8, 8, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(gf, gr, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    """Sequence sharded over sp=8: ring result == dense attention on the
+    unsharded sequence, including cross-device causal masking."""
+    mesh = make_mesh(dp=1, sp=8)
+    q, k, v = qkv(3, t=32)  # t_local = 4
+    expected = reference_attention(q, k, v, causal=causal)
+
+    def body(q, k, v):
+        return ring_attention(q, k, v, "sp", 8, causal=causal)
+
+    got = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(None, None, "sp"), P(None, None, "sp"), P(None, None, "sp")),
+            out_specs=P(None, None, "sp"),
+            check_vma=False,
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_ring_attention_differentiable():
+    mesh = make_mesh(dp=1, sp=8)
+    q, k, v = qkv(4, b=1, h=1, t=16, d=8)
+
+    def ring_loss(q, k, v):
+        def body(q, k, v):
+            return ring_attention(q, k, v, "sp", 8, causal=True)
+
+        out = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(None, None, "sp"),) * 3,
+            out_specs=P(None, None, "sp"),
+            check_vma=False,
+        )(q, k, v)
+        return jnp.sum(out**2)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for gr, ge in zip(g_ring, g_ref):
+        np.testing.assert_allclose(gr, ge, atol=1e-4)
+
+
+def _tiny_actor(obs_dim=5, act_dim=3, t=16):
+    actor = SequenceActor(
+        act_dim=act_dim, d_model=32, num_heads=2, num_layers=1, max_len=64
+    )
+    obs = jax.random.normal(jax.random.key(5), (2, t, obs_dim))
+    params = actor.init(jax.random.key(6), obs, jax.random.key(7))
+    return actor, params, obs
+
+
+def test_sequence_actor_shapes():
+    actor, params, obs = _tiny_actor()
+    action, logp = actor.apply(params, obs, jax.random.key(8))
+    assert action.shape == (2, 3)
+    assert logp.shape == (2,)
+    assert bool(jnp.all(jnp.abs(action) <= 1.0))
+    assert bool(jnp.all(jnp.isfinite(logp)))
+
+
+def test_sequence_trunk_is_causal():
+    """Perturbing future observations must not change past positions."""
+    actor, params, obs = _tiny_actor()
+    h = actor.apply(params, obs, method=SequenceActor.trunk)
+    obs2 = obs.at[:, -1].set(obs[:, -1] + 100.0)
+    h2 = actor.apply(params, obs2, method=SequenceActor.trunk)
+    np.testing.assert_allclose(h[:, :-1], h2[:, :-1], atol=1e-6)
+    assert not np.allclose(h[:, -1], h2[:, -1])
+
+
+def test_context_parallel_actor_matches_single_device():
+    actor, params, obs = _tiny_actor(t=16)
+    mesh = make_mesh(dp=1, sp=8)
+    a_single, _ = actor.apply(params, obs, None, True)  # deterministic
+    a_ring, _ = context_parallel_actor_step(
+        actor, params, obs, None, mesh, deterministic=True
+    )
+    np.testing.assert_allclose(a_ring, a_single, atol=1e-5)
+
+
+def test_context_parallel_actor_stochastic_logprob():
+    actor, params, obs = _tiny_actor(t=16)
+    mesh = make_mesh(dp=1, sp=8)
+    action, logp = context_parallel_actor_step(
+        actor, params, obs, jax.random.key(9), mesh
+    )
+    assert action.shape == (2, 3)
+    assert bool(jnp.all(jnp.isfinite(logp)))
+
+
+def test_sequence_double_critic_shapes():
+    critic = SequenceDoubleCritic(d_model=32, num_heads=2, num_layers=1, max_len=64)
+    obs = jax.random.normal(jax.random.key(10), (4, 8, 5))
+    act = jax.random.normal(jax.random.key(11), (4, 3))
+    params = critic.init(jax.random.key(12), obs, act)
+    qs = critic.apply(params, obs, act)
+    assert qs.shape == (2, 4)
+    assert bool(jnp.all(jnp.isfinite(qs)))

@@ -50,9 +50,11 @@ def make_chunk(key, n_dev, per_dev):
 
 def test_mesh_shapes():
     mesh = make_mesh(dp=4, tp=2)
-    assert mesh.shape == {"dp": 4, "tp": 2}
+    assert mesh.shape == {"dp": 4, "tp": 2, "sp": 1}
     mesh = make_mesh()
     assert mesh.shape["dp"] == 8
+    mesh = make_mesh(dp=2, sp=4)
+    assert mesh.shape == {"dp": 2, "tp": 1, "sp": 4}
 
 
 def test_sharded_buffer_layout():

@@ -8,3 +8,9 @@ from torch_actor_critic_tpu.models.visual import (  # noqa: F401
     VisualDoubleCritic,
     conv_output_size,
 )
+from torch_actor_critic_tpu.models.sequence import (  # noqa: F401
+    SequenceActor,
+    SequenceCritic,
+    SequenceDoubleCritic,
+    SequenceTrunk,
+)

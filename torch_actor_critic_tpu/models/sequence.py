@@ -1,0 +1,227 @@
+"""Sequence (transformer) policies and critics over observation histories.
+
+A capability **extension** — the reference's models are feedforward over
+fixed-width observation vectors with no sequence axis anywhere
+(SURVEY.md §5 "Long-context: absent by construction"). These modules
+give the framework a long-context policy class for partially-observable
+tasks: a causal transformer encoder over the last ``T`` observations,
+with the same squashed-Gaussian head as the MLP actor (ref
+``networks/linear.py:39-51`` math, shared via
+:mod:`torch_actor_critic_tpu.ops.distributions`), so a
+``SequenceActor`` drops into the SAC losses wherever ``Actor`` does.
+
+Designed for the distributed path from the start: the trunk takes a
+``pos_offset`` (global position of this device's local chunk) and an
+injectable ``attention_fn``, which is exactly the surface
+:mod:`torch_actor_critic_tpu.parallel.context` needs to run the same
+module under ``shard_map`` with ring attention over an ``sp`` mesh axis.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from torch_actor_critic_tpu.models.mlp import Dense
+from torch_actor_critic_tpu.ops.attention import attention as sdpa
+from torch_actor_critic_tpu.ops.distributions import squashed_gaussian_sample
+
+# attention_fn(q, k, v, causal) -> out, all (batch, heads, seq, head_dim)
+AttentionFn = t.Callable[..., jax.Array]
+
+
+def default_attention(q, k, v, causal=True):
+    return sdpa(q, k, v, causal=causal)
+
+
+class MultiHeadAttention(nn.Module):
+    """Causal MHA with a pluggable attention kernel."""
+
+    num_heads: int
+    attention_fn: AttentionFn = default_attention
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, s, d_model = x.shape
+        assert d_model % self.num_heads == 0, (d_model, self.num_heads)
+        head_dim = d_model // self.num_heads
+
+        def split(y):  # (B, T, D) -> (B, H, T, d)
+            return y.reshape(b, s, self.num_heads, head_dim).transpose(0, 2, 1, 3)
+
+        q = split(Dense(d_model)(x))
+        k = split(Dense(d_model)(x))
+        v = split(Dense(d_model)(x))
+        out = self.attention_fn(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, d_model)
+        return Dense(d_model)(out)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN block: LN → MHA → residual, LN → GELU MLP → residual."""
+
+    num_heads: int
+    mlp_ratio: int = 4
+    attention_fn: AttentionFn = default_attention
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d_model = x.shape[-1]
+        x = x + MultiHeadAttention(self.num_heads, self.attention_fn)(
+            nn.LayerNorm()(x)
+        )
+        h = nn.LayerNorm()(x)
+        h = Dense(self.mlp_ratio * d_model)(h)
+        h = nn.gelu(h)
+        h = Dense(d_model)(h)
+        return x + h
+
+
+class SequenceTrunk(nn.Module):
+    """Embed + positional encode + N causal transformer blocks.
+
+    ``pos_offset`` is the global index of this chunk's first timestep —
+    0 on a single device; ``axis_index('sp') * T_local`` under context
+    parallelism, so positional embeddings stay globally consistent when
+    the sequence is sharded.
+    """
+
+    d_model: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 512
+    attention_fn: AttentionFn = default_attention
+
+    @nn.compact
+    def __call__(self, obs_seq: jax.Array, pos_offset: jax.Array | int = 0):
+        b, s, _ = obs_seq.shape
+        # jnp.take clamps out-of-bounds rows silently — aliased positions
+        # would train without error, so reject oversized histories here.
+        # (Under sp sharding `s` is the local chunk; the context wrapper
+        # checks the global length against max_len.)
+        assert s <= self.max_len, (
+            f"history length {s} exceeds max_len={self.max_len}"
+        )
+        x = Dense(self.d_model)(obs_seq)
+        pos_table = self.param(
+            "pos_embedding",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.d_model),
+        )
+        pos = pos_offset + jnp.arange(s)
+        x = x + jnp.take(pos_table, pos, axis=0)[None]
+        for _ in range(self.num_layers):
+            x = TransformerBlock(self.num_heads, attention_fn=self.attention_fn)(x)
+        return nn.LayerNorm()(x)
+
+
+class SequenceActor(nn.Module):
+    """Squashed-Gaussian policy conditioned on an observation history.
+
+    ``__call__`` maps ``(B, T, obs_dim)`` histories to the action for
+    the latest timestep; :meth:`trunk` / :meth:`head` are exposed
+    separately so the context-parallel wrapper can insert the
+    cross-device last-token gather between them.
+    """
+
+    act_dim: int
+    d_model: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 512
+    act_limit: float = 1.0
+    attention_fn: AttentionFn = default_attention
+
+    def setup(self):
+        self._trunk = SequenceTrunk(
+            self.d_model, self.num_heads, self.num_layers, self.max_len,
+            self.attention_fn,
+        )
+        self._mu = Dense(self.act_dim)
+        self._log_std = Dense(self.act_dim)
+
+    def trunk(self, obs_seq: jax.Array, pos_offset: jax.Array | int = 0):
+        return self._trunk(obs_seq, pos_offset)
+
+    def head(
+        self,
+        h: jax.Array,
+        key: jax.Array | None = None,
+        deterministic: bool = False,
+        with_logprob: bool = True,
+    ):
+        mu = self._mu(h)
+        log_std = self._log_std(h)
+        return squashed_gaussian_sample(
+            key, mu, log_std, self.act_limit, deterministic, with_logprob
+        )
+
+    def __call__(
+        self,
+        obs_seq: jax.Array,
+        key: jax.Array | None = None,
+        deterministic: bool = False,
+        with_logprob: bool = True,
+    ):
+        h = self.trunk(obs_seq)[:, -1]
+        return self.head(h, key, deterministic, with_logprob)
+
+
+class SequenceCritic(nn.Module):
+    """Q(h_T, a): history-conditioned Q-network.
+
+    The trunk encodes the history; the last token's representation is
+    concatenated with the action and scored by a 2-layer MLP — the
+    sequence analogue of ``Critic``'s concat([obs, act]) (ref
+    ``networks/linear.py:62``).
+    """
+
+    d_model: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 512
+    hidden: int = 256
+    attention_fn: AttentionFn = default_attention
+
+    @nn.compact
+    def __call__(self, obs_seq: jax.Array, action: jax.Array) -> jax.Array:
+        h = SequenceTrunk(
+            self.d_model, self.num_heads, self.num_layers, self.max_len,
+            self.attention_fn,
+        )(obs_seq)[:, -1]
+        x = jnp.concatenate([h, action], axis=-1)
+        x = nn.relu(Dense(self.hidden)(x))
+        x = Dense(1)(x)
+        return jnp.squeeze(x, axis=-1)
+
+
+class SequenceDoubleCritic(nn.Module):
+    """Twin (or ``num_qs``-wide) ensemble of :class:`SequenceCritic`,
+    vmapped over parameters like
+    :class:`~torch_actor_critic_tpu.models.critic.DoubleCritic`."""
+
+    d_model: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 512
+    hidden: int = 256
+    num_qs: int = 2
+    attention_fn: AttentionFn = default_attention
+
+    @nn.compact
+    def __call__(self, obs_seq: jax.Array, action: jax.Array) -> jax.Array:
+        ensemble = nn.vmap(
+            SequenceCritic,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=None,
+            out_axes=0,
+            axis_size=self.num_qs,
+        )
+        return ensemble(
+            self.d_model, self.num_heads, self.num_layers, self.max_len,
+            self.hidden, self.attention_fn, name="ensemble",
+        )(obs_seq, action)

@@ -1,0 +1,370 @@
+"""Scaled-dot-product attention: blockwise (XLA) and flash (Pallas TPU).
+
+The reference has no attention anywhere — its models are feedforward
+MLPs/CNNs over fixed-width observation vectors (SURVEY.md §5
+"Long-context: absent by construction"). This module is the compute
+core of the framework's long-context *extension*: sequence policies
+(:mod:`torch_actor_critic_tpu.models.sequence`) and ring-attention
+context parallelism (:mod:`torch_actor_critic_tpu.parallel.context`)
+both reduce to the online-softmax block update defined here.
+
+Three implementations of the same math, one contract:
+
+- :func:`reference_attention` — materializes the full ``(Tq, Tk)``
+  score matrix. O(T^2) memory; ground truth for tests.
+- :func:`blockwise_attention` — FlashAttention-style online softmax
+  over K/V blocks via ``lax.scan``: O(block) memory, differentiable,
+  runs on any backend. This is the training-path default.
+- :func:`flash_attention` — a Pallas TPU kernel of the same loop:
+  grid ``(batch·heads, q-blocks, k-blocks)`` so VMEM only ever holds
+  one ``(block, head_dim)`` tile of each operand (long sequences
+  stream from HBM through the BlockSpec pipeline), MXU matmuls with
+  f32 accumulators in VMEM scratch. Wrapped in a ``custom_vjp`` whose
+  backward recomputes through :func:`blockwise_attention`, so the fast
+  forward is still fully differentiable. Head dims are zero-padded to
+  the 128-lane width transparently.
+
+All take ``(batch, heads, seq, head_dim)`` arrays. ``q_offset`` /
+``k_offset`` are *global* position offsets of the local q/k chunks —
+the hook that lets ring attention apply a correct causal mask when the
+sequence axis is sharded across devices.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import typing as t
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float("-inf")
+
+
+def reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    q_offset: jax.Array | int = 0,
+    k_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Plain softmax(QK^T/sqrt(d))V with the full score matrix."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(q.shape[-1])
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+    # Rows with no visible key (possible when k_offset > q position, as
+    # happens for future chunks in ring attention) would softmax to NaN;
+    # zero them instead to match the online-softmax convention.
+    all_masked = jnp.all(scores == NEG_INF, axis=-1, keepdims=True)
+    weights = jax.nn.softmax(jnp.where(all_masked, 0.0, scores), axis=-1)
+    weights = jnp.where(all_masked, 0.0, weights)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+def online_block_update(
+    q: jax.Array,
+    k_blk: jax.Array,
+    v_blk: jax.Array,
+    m: jax.Array,
+    l: jax.Array,
+    acc: jax.Array,
+    causal: bool = False,
+    q_offset: jax.Array | int = 0,
+    k_offset: jax.Array | int = 0,
+    k_end: jax.Array | int | None = None,
+    scale: float | None = None,
+) -> t.Tuple[jax.Array, jax.Array, jax.Array]:
+    """One online-softmax accumulation step against a K/V block.
+
+    Carries ``(m, l, acc)`` — running row max, normalizer, and
+    unnormalized output — in float32. ``k_end`` (a *global* position
+    bound) masks a pad tail; ``causal`` masks in global coordinates via
+    the offsets. Safe when the block is entirely masked (contributes
+    nothing). The single update body shared by the scan path here, the
+    cross-device ring in ``parallel/context.py``, and mirrored by the
+    Pallas kernel.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+    ) * scale
+    if causal or k_end is not None:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        valid = True
+        if k_end is not None:
+            valid = k_pos < k_end
+        if causal:
+            q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+            valid = valid & (q_pos >= k_pos)
+        scores = jnp.where(valid, scores, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+    # exp(-inf - -inf) = NaN; a fully-masked row keeps m_new == -inf and
+    # must contribute exp(...) = 0.
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(scores - safe_m[..., None])
+    p = jnp.where(jnp.isneginf(scores), 0.0, p)
+    alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+    l = l * alpha + jnp.sum(p, axis=-1)
+    acc = acc * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l, acc
+
+
+def finalize_online(m: jax.Array, l: jax.Array, acc: jax.Array) -> jax.Array:
+    """Normalize the online-softmax accumulator; all-masked rows → 0."""
+    return acc / jnp.where(l == 0.0, 1.0, l)[..., None]
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    q_offset: jax.Array | int = 0,
+    k_offset: jax.Array | int = 0,
+    block_k: int = 256,
+) -> jax.Array:
+    """Online-softmax attention scanning over K/V blocks.
+
+    Never materializes the ``(Tq, Tk)`` matrix: peak memory is
+    O(Tq · block_k) per (batch, head). Differentiable (plain jnp under
+    ``lax.scan``), so it is the training-path implementation.
+    """
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_k = min(block_k, tk)
+    if tk % block_k:  # pad K/V to a block multiple; pad tail masked out
+        pad = block_k - tk % block_k
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    n_blocks = k.shape[2] // block_k
+    k_blocks = k.reshape(b, h, n_blocks, block_k, d).transpose(2, 0, 1, 3, 4)
+    v_blocks = v.reshape(b, h, n_blocks, block_k, d).transpose(2, 0, 1, 3, 4)
+
+    qf = q.astype(jnp.float32)
+    init = (
+        jnp.full((b, h, tq), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, tq), jnp.float32),
+        jnp.zeros((b, h, tq, d), jnp.float32),
+    )
+    padded = k.shape[2] != tk
+
+    def body(carry, blk):
+        j, k_blk, v_blk = blk
+        m, l, acc = carry
+        m, l, acc = online_block_update(
+            qf, k_blk, v_blk, m, l, acc,
+            causal=causal,
+            q_offset=q_offset,
+            k_offset=k_offset + j * block_k,
+            k_end=k_offset + tk if padded else None,
+        )
+        return (m, l, acc), None
+
+    idx = jnp.arange(n_blocks)
+    (m, l, acc), _ = jax.lax.scan(body, init, (idx, k_blocks, v_blocks))
+    return finalize_online(m, l, acc).astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# Pallas TPU flash-attention kernel
+# --------------------------------------------------------------------------
+
+_LANE = 128  # TPU lane width: last tile dim, and scratch column count
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, block_q: int, block_k: int, scale: float, causal: bool,
+):
+    """One ``(batch·head, q-block, k-block)`` program.
+
+    The k-block grid dimension is innermost, so for a fixed q block the
+    programs run j = 0..nk-1 in order, carrying the online-softmax state
+    in VMEM scratch (``m``/``l`` use column 0 of a (block_q, LANE)
+    tile); the final k step normalizes into ``o_ref``. Same update math
+    as :func:`online_block_update`.
+    """
+    from jax.experimental import pallas as pl  # deferred: TPU-only path
+
+    iq = pl.program_id(1)
+    j = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Under causality, K blocks strictly past this q block's diagonal
+    # contribute nothing; skip their compute entirely.
+    needed = True if not causal else j * block_k <= (iq + 1) * block_q - 1
+
+    @pl.when(needed)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        m = m_ref[:, 0]
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(scores - safe_m[:, None])
+        p = jnp.where(jnp.isneginf(scores), 0.0, p)
+        alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:, 0] = m_new
+
+    @pl.when(j == n_kb - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        o_ref[0] = (
+            acc_ref[:] / jnp.where(l == 0.0, 1.0, l)[:, None]
+        ).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(
+            f"flash_attention: Tq={tq} must divide by block_q={block_q} and "
+            f"Tk={tk} by block_k={block_k}; use attention(impl='xla') or "
+            "blockwise_attention for ragged lengths."
+        )
+    # The softmax scale uses the *logical* head dim; zero-pad the head
+    # axis to the lane width (dot products are unchanged by zero columns,
+    # padded output columns are sliced away).
+    scale = 1.0 / math.sqrt(d)
+    if d % _LANE:
+        pad = _LANE - d % _LANE
+        q, k, v = (
+            jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, pad))) for x in (q, k, v)
+        )
+    dp = q.shape[-1]
+    qr = q.reshape(b * h, tq, dp)
+    kr = k.reshape(b * h, tk, dp)
+    vr = v.reshape(b * h, tk, dp)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            block_q=block_q, block_k=block_k, scale=scale, causal=causal,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, dp), q.dtype),
+        grid=(b * h, tq // block_q, tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda bh, iq, j: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dp), lambda bh, iq, j: (bh, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, dp), lambda bh, iq, j: (bh, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dp), lambda bh, iq, j: (bh, iq, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # m (col 0)
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # l (col 0)
+            pltpu.VMEM((block_q, dp), jnp.float32),     # acc
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, tq, dp)[..., :d]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Pallas TPU flash attention (forward); backward recomputes via
+    :func:`blockwise_attention`'s VJP, so gradients are exact.
+
+    Requires ``Tq % block_q == 0`` and ``Tk % block_k == 0`` (raises
+    ``ValueError`` otherwise); any head dim works (zero-padded to the
+    128-lane width internally). ``interpret=True`` runs the kernel in
+    the Pallas interpreter (CPU-testable; used by the test suite).
+    """
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(q, k, v, causal, block_k=block_k),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    impl: str = "auto",
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Dispatch: ``'pallas'`` kernel on TPU-compatible shapes,
+    ``'xla'`` blockwise scan otherwise; ``'auto'`` picks per backend."""
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        shapes_ok = (
+            q.shape[2] % min(block_q, q.shape[2]) == 0
+            and k.shape[2] % min(block_k, k.shape[2]) == 0
+        )
+        impl = "pallas" if (on_tpu and shapes_ok) else "xla"
+    if impl == "pallas":
+        return flash_attention(q, k, v, causal, block_q, block_k)
+    return blockwise_attention(q, k, v, causal, block_k=block_k)

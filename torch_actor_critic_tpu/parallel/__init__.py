@@ -8,3 +8,8 @@ from torch_actor_critic_tpu.parallel.distributed import (  # noqa: F401
     initialize_multihost,
     is_coordinator,
 )
+from torch_actor_critic_tpu.parallel.context import (  # noqa: F401
+    context_parallel_actor_step,
+    make_ring_attention_fn,
+    ring_attention,
+)

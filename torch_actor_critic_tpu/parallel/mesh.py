@@ -12,6 +12,12 @@ The TPU-native replacement for the reference's MPI world
   (:mod:`torch_actor_critic_tpu.parallel.sharding`). An extension
   beyond the reference's capability envelope; ``tp=1`` (default)
   reduces to pure DP.
+- ``sp`` — sequence/context parallelism: observation histories sharded
+  over the sequence axis with ring attention
+  (:mod:`torch_actor_critic_tpu.parallel.context`). Also an extension
+  (the reference has no sequence axis, SURVEY.md §5); ``sp`` is laid
+  out fastest-varying so ring ``ppermute`` hops ride neighboring ICI
+  links.
 
 Where the reference re-execs itself under ``mpirun`` and every rank
 re-runs ``main()`` (ref ``sac/mpi.py:24-34``), a JAX mesh is just data:
@@ -33,22 +39,26 @@ from jax.sharding import Mesh
 def make_mesh(
     dp: int | None = None,
     tp: int = 1,
+    sp: int = 1,
     devices: t.Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """Build a ``(dp, tp)`` mesh.
+    """Build a ``(dp, tp, sp)`` mesh.
 
-    ``dp=None`` uses all available devices (divided by ``tp``). The
-    ``dp`` axis is laid out over the fastest-varying device order so DP
-    collectives ride ICI neighbors.
+    ``dp=None`` uses all available devices (divided by ``tp * sp``).
+    ``sp`` then ``tp`` vary fastest so sequence-ring and tensor
+    collectives ride ICI neighbors; ``dp`` allreduces span the slower
+    links, matching their once-per-burst cadence.
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     if dp is None:
-        if n % tp != 0:
-            raise ValueError(f"{n} devices not divisible by tp={tp}")
-        dp = n // tp
-    if dp * tp > n:
-        raise ValueError(f"mesh ({dp}x{tp}) needs {dp * tp} devices, have {n}")
-    grid = np.asarray(devices[: dp * tp]).reshape(dp, tp)
-    return Mesh(grid, axis_names=("dp", "tp"))
+        if n % (tp * sp) != 0:
+            raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
+        dp = n // (tp * sp)
+    if dp * tp * sp > n:
+        raise ValueError(
+            f"mesh ({dp}x{tp}x{sp}) needs {dp * tp * sp} devices, have {n}"
+        )
+    grid = np.asarray(devices[: dp * tp * sp]).reshape(dp, tp, sp)
+    return Mesh(grid, axis_names=("dp", "tp", "sp"))
