@@ -4,8 +4,9 @@ from torch_actor_critic_tpu.ops.distributions import (  # noqa: F401
     tanh_log_prob_correction,
 )
 from torch_actor_critic_tpu.ops.polyak import polyak_update  # noqa: F401
+# NOTE: the `attention` dispatch *function* is deliberately not re-exported
+# here — it would shadow the `ops.attention` submodule attribute.
 from torch_actor_critic_tpu.ops.attention import (  # noqa: F401
-    attention,
     blockwise_attention,
     flash_attention,
     reference_attention,

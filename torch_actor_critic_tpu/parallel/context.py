@@ -22,6 +22,7 @@ run their own sequence ring.
 
 from __future__ import annotations
 
+import functools
 import typing as t
 
 import jax
@@ -94,6 +95,44 @@ def make_ring_attention_fn(axis_name: str, axis_size: int):
     return fn
 
 
+@functools.lru_cache(maxsize=32)
+def _build_context_actor_step(
+    actor, mesh: Mesh, deterministic: bool, with_logprob: bool
+):
+    """Compiled (actor, mesh, flags) → step callable. Cached so repeated
+    calls (the per-env-step acting path) hit one jitted executable
+    instead of re-tracing a fresh shard_map closure each time; flax
+    modules and Mesh are hashable by value, so equal configs share an
+    entry."""
+    from torch_actor_critic_tpu.models.sequence import SequenceActor
+
+    n = mesh.shape["sp"]
+    ring_actor = actor.clone(attention_fn=make_ring_attention_fn("sp", n))
+
+    def body(params, obs_local, key):
+        t_local = obs_local.shape[1]
+        idx = jax.lax.axis_index("sp")
+        h = ring_actor.apply(
+            params, obs_local, idx * t_local, method=SequenceActor.trunk
+        )
+        last = jnp.where(idx == n - 1, h[:, -1], jnp.zeros_like(h[:, -1]))
+        last = jax.lax.psum(last, "sp")
+        return ring_actor.apply(
+            params, last, key, deterministic, with_logprob,
+            method=SequenceActor.head,
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(None, "sp", None), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
 def context_parallel_actor_step(
     actor,
     params,
@@ -114,34 +153,11 @@ def context_parallel_actor_step(
     device, so the returned ``(action, log_prob)`` are replicated.
     Single-device ``sp=1`` reduces exactly to ``actor(obs_seq, key)``.
     """
-    from torch_actor_critic_tpu.models.sequence import SequenceActor
-
     n = mesh.shape["sp"]
     assert obs_seq.shape[1] % n == 0, (obs_seq.shape, n)
     assert obs_seq.shape[1] <= actor.max_len, (
         f"global history length {obs_seq.shape[1]} exceeds the actor's "
         f"max_len={actor.max_len} (positional table would alias)"
     )
-    ring_actor = actor.clone(attention_fn=make_ring_attention_fn("sp", n))
-
-    def body(params, obs_local, key):
-        t_local = obs_local.shape[1]
-        idx = jax.lax.axis_index("sp")
-        h = ring_actor.apply(
-            params, obs_local, idx * t_local, method=SequenceActor.trunk
-        )
-        last = jnp.where(idx == n - 1, h[:, -1], jnp.zeros_like(h[:, -1]))
-        last = jax.lax.psum(last, "sp")
-        return ring_actor.apply(
-            params, last, key, deterministic, with_logprob,
-            method=SequenceActor.head,
-        )
-
-    mapped = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(), P(None, "sp", None), P()),
-        out_specs=P(),
-        check_vma=False,
-    )
-    return mapped(params, obs_seq, key)
+    step = _build_context_actor_step(actor, mesh, deterministic, with_logprob)
+    return step(params, obs_seq, key)

@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torch_actor_critic_tpu.buffer.replay import init_replay_buffer
 from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
+from torch_actor_critic_tpu.parallel import sharding as tp_sharding
 from torch_actor_critic_tpu.sac.algorithm import SAC, Metrics
 
 
@@ -95,6 +96,7 @@ class DataParallelSAC:
         self.sac = sac
         self.mesh = mesh
         self.n_devices = mesh.shape["dp"]
+        self.tp = mesh.shape.get("tp", 1)
         self._burst = None
         self._push = None
         self._select_action = None
@@ -105,8 +107,12 @@ class DataParallelSAC:
         """Initialize once and replicate across the mesh — the moral
         equivalent of rank-0 init + ``sync_params`` Bcast
         (ref ``sac/algorithm.py:198-200``); thereafter pmean'd grads
-        keep every replica bit-identical."""
+        keep every replica bit-identical. On a ``tp>1`` mesh, weight
+        matrices land tensor-sharded (dp-replicated, tp-partitioned)
+        per :func:`~torch_actor_critic_tpu.parallel.sharding.tp_specs`."""
         state = self.sac.init_state(key, example_obs)
+        if self.tp > 1:
+            return tp_sharding.shard_params(state, self.mesh)
         rep = NamedSharding(self.mesh, P())
         return jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), state)
 
@@ -127,6 +133,10 @@ class DataParallelSAC:
             # analogue of per-rank seeds (ref sac/algorithm.py:203-205).
             dev = jax.lax.axis_index(DataParallelSAC.AXIS)
             local = state.replace(rng=jax.random.fold_in(state.rng, dev))
+            # tp is a GSPMD *auto* axis inside this manual-dp body:
+            # re-assert the Megatron layout and the partitioner shards
+            # every matmul of the fused step, collectives included.
+            local = tp_sharding.constrain(local, mesh)
 
             local, buffer, metrics = sac.update_burst(
                 local, buffer, chunk, num_updates, axis_name=DataParallelSAC.AXIS
@@ -147,6 +157,9 @@ class DataParallelSAC:
             mesh=mesh,
             in_specs=(rep_spec, dp_spec, dp_spec),
             out_specs=(rep_spec, dp_spec, rep_spec),
+            # Manual collectives over dp only; tp (and sp) stay GSPMD
+            # auto axes so with_sharding_constraint works inside.
+            axis_names={"dp"},
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))

@@ -1,0 +1,103 @@
+"""GSPMD tensor-parallel parameter sharding over the ``tp`` mesh axis.
+
+An **extension** beyond the reference's capability envelope (its only
+strategy is MPI data parallelism, SURVEY.md §2 "Parallelism
+strategies"): when a model grows wider than one core's HBM or MXU
+appetite, its weight matrices are sharded across ``tp`` devices and XLA
+inserts the matching collectives. TPU-native design per the scaling-book
+recipe: we only *annotate* shardings — ``PartitionSpec`` on each kernel,
+Megatron-style alternation so consecutive layers compose as
+column-parallel → row-parallel with a single ``psum`` per pair — and the
+GSPMD partitioner materializes the all-reduces on ICI. No manual
+collective code.
+
+Composes with the manual-``dp`` path: ``DataParallelSAC`` runs its
+``shard_map`` with ``axis_names={'dp'}``, leaving ``tp`` an *auto* axis
+inside the body, where :func:`constrain` re-applies these specs and XLA
+partitions every matmul of the fused SAC step.
+"""
+
+from __future__ import annotations
+
+import re
+import typing as t
+
+import jax
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_INT = re.compile(r"_(\d+)$")
+
+
+def _path_depth(path: t.Tuple) -> int:
+    """Sum of the trailing integers of module names along a param path
+    (``MLP_0/Dense_3/Dense_0 -> 3``). Consecutive layers of one trunk
+    differ by one, which is exactly the parity the Megatron
+    column/row alternation needs."""
+    depth = 0
+    for entry in path:
+        name = getattr(entry, "key", None) or getattr(entry, "name", "")
+        m = _INT.search(str(name))
+        if m:
+            depth += int(m.group(1))
+    return depth
+
+
+def tp_spec(path: t.Tuple, leaf: jax.Array, tp: int) -> P:
+    """PartitionSpec for one parameter leaf.
+
+    Kernels ``(..., in, out)``: even path-depth shards ``out``
+    (column-parallel), odd shards ``in`` (row-parallel) — whichever is
+    chosen must divide by ``tp``, else the leaf stays replicated.
+    Biases follow their layer's activation sharding (sharded only for
+    column-parallel layers). Leading axes (e.g. the critic-ensemble
+    ``num_qs`` axis) are never sharded.
+    """
+    name = str(getattr(path[-1], "key", path[-1]) if path else "")
+    even = _path_depth(path) % 2 == 0
+    shape = leaf.shape
+    if name == "kernel" and leaf.ndim >= 2:
+        if even and shape[-1] % tp == 0:
+            return P(*([None] * (leaf.ndim - 1)), "tp")
+        if not even and shape[-2] % tp == 0:
+            return P(*([None] * (leaf.ndim - 2)), "tp", None)
+        return P()
+    if name == "bias" and leaf.ndim >= 1 and even and shape[-1] % tp == 0:
+        return P(*([None] * (leaf.ndim - 1)), "tp")
+    return P()
+
+
+def tp_specs(params: t.Any, tp: int) -> t.Any:
+    """Pytree of PartitionSpecs matching ``params``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: tp_spec(path, leaf, tp), params
+    )
+
+
+def shard_params(params: t.Any, mesh: Mesh) -> t.Any:
+    """Place params on the mesh with tensor-parallel shardings (at-rest
+    layout; ``tp=1`` meshes place everything replicated)."""
+    tp = mesh.shape.get("tp", 1)
+    specs = tp_specs(params, tp)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def constrain(params: t.Any, mesh: Mesh) -> t.Any:
+    """``with_sharding_constraint`` version of :func:`shard_params`, for
+    use inside traced code where ``tp`` is a GSPMD auto axis."""
+    tp = mesh.shape.get("tp", 1)
+    if tp == 1:
+        return params
+    specs = tp_specs(params, tp)
+    return jax.tree_util.tree_map(
+        # Only constrain leaves that actually shard: a P() constraint adds
+        # nothing, and skipping it keeps non-numeric leaves (PRNG keys,
+        # counters) out of the partitioner's way.
+        lambda x, s: x
+        if s == P()
+        else jax.lax.with_sharding_constraint(x, NamedSharding(mesh, s)),
+        params,
+        specs,
+    )
