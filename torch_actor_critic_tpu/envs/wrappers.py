@@ -114,9 +114,63 @@ class DmControlEnv:
         pass
 
 
+class HistoryEnv:
+    """Sliding-window observation history: base obs ``(D,)`` becomes
+    ``(horizon, D)`` with the newest frame last.
+
+    The env-side half of the sequence-policy extension
+    (:mod:`torch_actor_critic_tpu.models.sequence`) — the reference has
+    no history/sequence mechanism anywhere (SURVEY.md §5). On reset the
+    window is filled with the initial observation (no zero-state
+    transient). Requested via the ``"<name>|history:N"`` suffix so the
+    spec survives the string-only handoff to native env-pool workers.
+    """
+
+    def __init__(self, env, horizon: int):
+        if not hasattr(env.obs_spec, "shape"):
+            raise ValueError(
+                "HistoryEnv requires a flat array observation; got "
+                f"{type(env.obs_spec).__name__}"
+            )
+        self.env = env
+        self.horizon = int(horizon)
+        self.name = f"{env.name}|history:{horizon}"
+        self.act_dim = env.act_dim
+        self.act_limit = env.act_limit
+        base = env.obs_spec
+        self.obs_spec = jax.ShapeDtypeStruct((self.horizon,) + base.shape, base.dtype)
+        self._hist: np.ndarray | None = None
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        obs = self.env.reset(seed)
+        self._hist = np.tile(obs[None], (self.horizon,) + (1,) * obs.ndim)
+        return self._hist.copy()
+
+    def step(self, action: np.ndarray):
+        obs, reward, terminated, truncated = self.env.step(action)
+        self._hist = np.roll(self._hist, -1, axis=0)
+        self._hist[-1] = obs
+        return self._hist.copy(), reward, terminated, truncated
+
+    def sample_action(self) -> np.ndarray:
+        return self.env.sample_action()
+
+    def render(self):
+        return self.env.render()
+
+    def close(self):
+        self.env.close()
+
+
 def make_env(name: str, seed: int | None = None, **kwargs):
     """Single env factory (replaces ``gym.make`` dispatch +
-    string-matching in ref ``main.py:63,100-110,167``)."""
+    string-matching in ref ``main.py:63,100-110,167``).
+
+    ``"<base>|history:N"`` wraps the base env in :class:`HistoryEnv`.
+    """
+    if "|history:" in name:
+        base_name, _, horizon = name.rpartition("|history:")
+        return HistoryEnv(make_env(base_name, seed=seed, **kwargs), int(horizon))
     if name == "DeepMindWallRunner-v0":
         from torch_actor_critic_tpu.envs.wall_runner import DeepMindWallRunner
 

@@ -33,8 +33,26 @@ from torch_actor_critic_tpu.ops.distributions import squashed_gaussian_sample
 AttentionFn = t.Callable[..., jax.Array]
 
 
+def _auto_batch(obs_seq: jax.Array, *rest: jax.Array):
+    """Add a leading batch axis to an unbatched ``(T, D)`` history (and
+    companion arrays), like the visual stack's auto-reshape (ref
+    ``convolutional.py:91-96``). Returns ``(unbatched, obs_seq, *rest)``."""
+    unbatched = obs_seq.ndim == 2
+    if unbatched:
+        obs_seq = obs_seq[None]
+        rest = tuple(x[None] for x in rest)
+    return (unbatched, obs_seq, *rest)
+
+
 def default_attention(q, k, v, causal=True):
     return sdpa(q, k, v, causal=causal)
+
+
+def xla_attention(q, k, v, causal=True):
+    """Backend-portable attention (no Pallas): for modules that must
+    compile on the host CPU while TPU is the default backend, e.g. the
+    trainer's host actor mirror."""
+    return sdpa(q, k, v, causal=causal, impl="xla")
 
 
 class MultiHeadAttention(nn.Module):
@@ -166,8 +184,13 @@ class SequenceActor(nn.Module):
         deterministic: bool = False,
         with_logprob: bool = True,
     ):
+        unbatched, obs_seq = _auto_batch(obs_seq)
         h = self.trunk(obs_seq)[:, -1]
-        return self.head(h, key, deterministic, with_logprob)
+        action, logp = self.head(h, key, deterministic, with_logprob)
+        if unbatched:
+            action = jnp.squeeze(action, 0)
+            logp = jnp.squeeze(logp, 0) if logp is not None else None
+        return action, logp
 
 
 class SequenceCritic(nn.Module):
@@ -188,6 +211,7 @@ class SequenceCritic(nn.Module):
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, action: jax.Array) -> jax.Array:
+        unbatched, obs_seq, action = _auto_batch(obs_seq, action)
         h = SequenceTrunk(
             self.d_model, self.num_heads, self.num_layers, self.max_len,
             self.attention_fn,
@@ -195,7 +219,8 @@ class SequenceCritic(nn.Module):
         x = jnp.concatenate([h, action], axis=-1)
         x = nn.relu(Dense(self.hidden)(x))
         x = Dense(1)(x)
-        return jnp.squeeze(x, axis=-1)
+        q = jnp.squeeze(x, axis=-1)
+        return jnp.squeeze(q, 0) if unbatched else q
 
 
 class SequenceDoubleCritic(nn.Module):

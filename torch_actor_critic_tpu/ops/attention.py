@@ -357,7 +357,17 @@ def attention(
     block_k: int = 128,
 ) -> jax.Array:
     """Dispatch: ``'pallas'`` kernel on TPU-compatible shapes,
-    ``'xla'`` blockwise scan otherwise; ``'auto'`` picks per backend."""
+    ``'xla'`` blockwise scan otherwise; ``'auto'`` picks by the process
+    default backend.
+
+    CAUTION: ``'auto'`` bakes the choice in at trace time, so a
+    function compiled for a *non-default* backend (e.g. the trainer's
+    host-CPU actor mirror while TPU is default) must not rely on it —
+    pass an explicit ``impl`` or, for the sequence models, inject
+    ``models.sequence.xla_attention``. (``lax.platform_dependent`` is
+    not an option: XLA still lowers the dead Pallas branch on CPU and
+    ``pallas_call`` has no CPU lowering outside interpret mode.)
+    """
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         shapes_ok = (

@@ -79,6 +79,30 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
             normalize_pixels=config.normalize_pixels,
             num_qs=config.num_qs,
         )
+    elif len(env.obs_spec.shape) == 2:
+        # (history, obs_dim) observations from HistoryEnv → the
+        # causal-transformer sequence stack (extension; SURVEY.md §5).
+        from torch_actor_critic_tpu.models import (
+            SequenceActor,
+            SequenceDoubleCritic,
+        )
+
+        horizon = env.obs_spec.shape[0]
+        actor = SequenceActor(
+            act_dim=env.act_dim,
+            d_model=config.seq_d_model,
+            num_heads=config.seq_num_heads,
+            num_layers=config.seq_num_layers,
+            max_len=horizon,
+            act_limit=env.act_limit,
+        )
+        critic = SequenceDoubleCritic(
+            d_model=config.seq_d_model,
+            num_heads=config.seq_num_heads,
+            num_layers=config.seq_num_layers,
+            max_len=horizon,
+            num_qs=config.num_qs,
+        )
     else:
         actor = Actor(
             act_dim=env.act_dim,
@@ -133,8 +157,16 @@ class Trainer:
         # One env per dp mesh slice, stepped as a pool: sequential
         # in-process by default, parallel worker processes over the
         # native shared-memory runtime with `parallel_envs`.
+        # history_len > 1 selects the sequence-policy stack via the
+        # HistoryEnv name suffix (string-only, so it reaches native
+        # pool workers unchanged).
+        pool_name = (
+            f"{env_name}|history:{self.config.history_len}"
+            if self.config.history_len > 1
+            else env_name
+        )
         self.pool = make_env_pool(
-            env_name,
+            pool_name,
             self.n_envs,
             base_seed=seed,
             parallel=self.config.parallel_envs,
@@ -142,9 +174,14 @@ class Trainer:
             start_method=self.config.env_start_method,
         )
         self.visual = is_visual_env(env_name)
-        if self.config.normalize_observations and not self.visual:
+        flat_obs = (
+            not self.visual and len(self.pool.obs_spec.shape) == 1
+        )
+        if self.config.normalize_observations and flat_obs:
             self.normalizer = WelfordNormalizer(self.pool.obs_spec.shape[0])
         else:
+            # Welford tracks per-feature stats of flat vectors; visual
+            # and history observations run unnormalized.
             self.normalizer = IdentityNormalizer()
 
         actor_def, critic_def = build_models(self.config, self.pool)
@@ -161,15 +198,29 @@ class Trainer:
             jax.local_devices(backend="cpu")[0] if self.config.host_actor else None
         )
         self._host_params = None  # refreshed lazily after each burst
-        self._host_select = (
-            jax.jit(
-                self.sac.select_action,
-                static_argnames=("deterministic",),
-                backend="cpu",
+        if self.config.host_actor:
+            # The mirror compiles for the host CPU; a sequence actor's
+            # auto-dispatched attention would bake in the Pallas TPU
+            # kernel (no CPU lowering), so clone it onto the portable
+            # XLA attention path — same params, different kernel.
+            host_actor_def = self.sac.actor_def
+            if hasattr(host_actor_def, "attention_fn"):
+                from torch_actor_critic_tpu.models.sequence import xla_attention
+
+                host_actor_def = host_actor_def.clone(attention_fn=xla_attention)
+
+            def _select(params, obs, key, deterministic=False):
+                action, _ = host_actor_def.apply(
+                    params, obs, key,
+                    deterministic=deterministic, with_logprob=False,
+                )
+                return action
+
+            self._host_select = jax.jit(
+                _select, static_argnames=("deterministic",), backend="cpu"
             )
-            if self.config.host_actor
-            else None
-        )
+        else:
+            self._host_select = None
         # One-transfer param mirroring: the accelerator may sit behind a
         # high-latency link where every fetch pays a fixed RPC cost, so
         # params are flattened into a single buffer on-device and
@@ -188,6 +239,12 @@ class Trainer:
         example_obs = jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), self.pool.obs_spec
         )
+        # init must run on the default (accelerator) backend even when
+        # the acting key lives host-side — a CPU-committed key would
+        # drag eager module init onto CPU, where a sequence actor's
+        # Pallas attention cannot lower. local_devices, not devices:
+        # global device 0 is unaddressable on non-coordinator hosts.
+        init_key = jax.device_put(init_key, jax.local_devices()[0])
         self.state = self.dp.init_state(init_key, example_obs)
         per_dev_capacity = max(self.config.buffer_size // self.n_envs, 1)
         self.buffer = init_sharded_buffer(

@@ -103,6 +103,9 @@ def config_from_args(args: argparse.Namespace) -> SACConfig:
 
 def main(argv=None):
     args = parse_arguments(argv)
+    from torch_actor_critic_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
     initialize_multihost()
 
     from torch_actor_critic_tpu.sac.trainer import Trainer  # jax-heavy import

@@ -62,6 +62,17 @@ class SACConfig:
     cnn_features: int = 1  # 1 == reference scalar-vision bottleneck
     normalize_pixels: bool = False
 
+    # Sequence-policy extension: history_len > 1 wraps the env in a
+    # sliding observation window (envs/wrappers.py HistoryEnv) and
+    # dispatches to the causal-transformer SequenceActor/Critic stack
+    # (models/sequence.py) — long-context capability the reference
+    # lacks by construction (SURVEY.md §5). seq_* set the transformer
+    # geometry.
+    history_len: int = 1
+    seq_d_model: int = 64
+    seq_num_heads: int = 4
+    seq_num_layers: int = 2
+
     # Observation normalization (the reference ships a Welford
     # normalizer as dead code, ref sac/utils.py:27-65; here it's a
     # usable option).

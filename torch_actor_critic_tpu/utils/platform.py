@@ -1,0 +1,26 @@
+"""Backend-selection hygiene for CLI entry points.
+
+``JAX_PLATFORMS=cpu`` alone is not sufficient in environments whose
+sitecustomize hooks re-register an accelerator platform after jax
+import (the tunneled-TPU setup does); the config value must be
+re-asserted post-import or "CPU" runs silently build the accelerator
+client — and hang if its link is down. The graft/driver entry points
+and the test conftest already do this; CLIs route through here.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    """Re-assert ``JAX_PLATFORMS`` from the environment after import.
+
+    No-op when the var is unset or already names the active backend.
+    Call before any other jax API in a CLI ``main()``.
+    """
+    import jax
+
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want and "axon" not in want:
+        jax.config.update("jax_platforms", want)
