@@ -1,0 +1,102 @@
+"""On-device env + fully-fused training loop.
+
+Checks the pure-JAX pendulum against gymnasium's Pendulum-v1 dynamics
+step-for-step, then drives the fused collect+update loop (an extension
+the reference cannot express — its physics is host C code, SURVEY.md
+§7 (e)).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_actor_critic_tpu.envs.ondevice import PendulumJax, get_on_device_env
+from torch_actor_critic_tpu.models import Actor, DoubleCritic
+from torch_actor_critic_tpu.sac import SAC
+from torch_actor_critic_tpu.sac.ondevice import OnDeviceLoop
+from torch_actor_critic_tpu.utils.config import SACConfig
+
+
+def test_pendulum_matches_gymnasium_dynamics():
+    gymnasium = pytest.importorskip("gymnasium")
+    genv = gymnasium.make("Pendulum-v1")
+    genv.reset(seed=0)
+
+    state = PendulumJax.reset(jax.random.key(0))
+    theta, theta_dot = 0.7, -0.3
+    genv.unwrapped.state = np.array([theta, theta_dot])
+    state = state.replace(
+        inner=(jnp.float32(theta), jnp.float32(theta_dot)),
+        obs=PendulumJax._obs(jnp.float32(theta), jnp.float32(theta_dot)),
+    )
+
+    rng = np.random.default_rng(1)
+    for _ in range(50):
+        action = rng.uniform(-2.0, 2.0, size=(1,)).astype(np.float32)
+        gobs, grew, _, _, _ = genv.step(action)
+        state, out = PendulumJax.step(state, jnp.asarray(action))
+        np.testing.assert_allclose(out.next_obs, gobs, atol=1e-4)
+        np.testing.assert_allclose(float(out.reward), grew, atol=1e-4)
+    genv.close()
+
+
+def test_pendulum_auto_reset():
+    state = PendulumJax.reset(jax.random.key(0))
+    action = jnp.zeros((1,))
+    for i in range(PendulumJax.max_episode_steps):
+        state, out = PendulumJax.step(state, action)
+    assert bool(out.ended)
+    assert int(state.step_count) == 0  # fresh episode
+    assert float(state.episode_return) == 0.0
+    assert float(out.final_return) < 0.0  # the finished episode's return
+    # and it keeps going
+    state, out = PendulumJax.step(state, action)
+    assert not bool(out.ended)
+    assert int(state.step_count) == 1
+
+
+def test_registry():
+    assert get_on_device_env("Pendulum-v1") is PendulumJax
+    assert get_on_device_env("HalfCheetah-v5") is None
+
+
+def _loop(n_envs=8):
+    cfg = SACConfig(hidden_sizes=(32, 32), batch_size=32)
+    sac = SAC(
+        cfg,
+        Actor(act_dim=1, hidden_sizes=cfg.hidden_sizes, act_limit=2.0),
+        DoubleCritic(hidden_sizes=cfg.hidden_sizes),
+        1,
+    )
+    return OnDeviceLoop(sac, PendulumJax, n_envs=n_envs)
+
+
+def test_fused_epoch_mechanics():
+    loop = _loop()
+    ts, buf, es, key = loop.init(jax.random.key(0), buffer_capacity=10_000)
+
+    ts, buf, es, key, m = loop.epoch(ts, buf, es, key, steps=50, warmup=True)
+    assert int(buf.size) == 50 * 8
+    assert int(ts.step) == 0  # warmup: no gradient steps
+
+    ts, buf, es, key, m = loop.epoch(ts, buf, es, key, steps=100, update_every=50)
+    assert int(ts.step) == 100
+    assert int(buf.size) == 150 * 8
+    assert np.isfinite(float(m["loss_q"]))
+    assert np.isfinite(float(m["loss_pi"]))
+
+
+def test_fused_training_improves_return():
+    """~20k grad steps of fused SAC must beat the random policy by a
+    wide margin (random pendulum ≈ -1200 per episode)."""
+    loop = _loop(n_envs=8)
+    ts, buf, es, key = loop.init(jax.random.key(1), buffer_capacity=100_000)
+    ts, buf, es, key, m0 = loop.epoch(ts, buf, es, key, steps=200, warmup=True)
+    first = None
+    for _ in range(8):
+        ts, buf, es, key, m = loop.epoch(ts, buf, es, key, steps=2500, update_every=50)
+        if first is None:
+            first = float(m["reward"])
+    assert float(m["reward"]) > first + 100.0, (first, float(m["reward"]))
+    assert float(m["reward"]) > -1000.0, float(m["reward"])

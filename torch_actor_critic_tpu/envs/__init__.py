@@ -15,5 +15,10 @@ otherwise defers to ``gym.make`` (ref ``main.py:167``). Here
 from torch_actor_critic_tpu.envs.wrappers import (  # noqa: F401
     DmControlEnv,
     GymnasiumEnv,
+    HistoryEnv,
     make_env,
+)
+from torch_actor_critic_tpu.envs.ondevice import (  # noqa: F401
+    PendulumJax,
+    get_on_device_env,
 )

@@ -1,0 +1,136 @@
+"""On-device (pure-JAX) environments.
+
+An **extension** beyond the reference, whose physics is host-side C
+(MuJoCo/dm_control through gym, ref ``main.py:167``) and whose
+throughput ceiling is therefore the Python env loop (SURVEY.md §7 hard
+parts (a)/(e)). A pure-``jnp`` env steps *inside* the compiled program:
+the whole collect→push→update cycle fuses into one XLA dispatch with
+zero host↔device transfers (see
+:mod:`torch_actor_critic_tpu.sac.ondevice`), the Podracer/JaxMARL
+design (PAPERS.md).
+
+Protocol (all pure functions over :class:`EnvState`):
+
+- ``reset(key) -> EnvState`` — one env; ``vmap`` for a batch.
+- ``step(state, action) -> (EnvState, StepOut)`` — auto-resets on
+  episode end (the returned state is the *next* episode's first state
+  when ``StepOut.ended``); ``StepOut.next_obs`` is the pre-reset
+  observation, the one the replay buffer must store. A pendulum episode
+  only ever *truncates*, so ``StepOut.terminated`` stays 0 and the SAC
+  backup keeps bootstrapping (the reference's max_ep_len bypass, ref
+  ``sac/algorithm.py:241``).
+
+``PendulumJax`` implements the classic pendulum swing-up (the same
+dynamics as gymnasium's ``Pendulum-v1``: theta'' = 3g/(2l) sin(theta)
++ 3/(m l^2) u, dt=0.05, torque/speed clipping, reward
+-(theta^2 + 0.1 theta_dot^2 + 0.001 u^2)) so on-device results are
+directly comparable to the host-env path on the same task.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class EnvState:
+    """Vectorizable env state: physics variables + episode bookkeeping."""
+
+    inner: t.Any  # env-specific physics state pytree
+    obs: jax.Array
+    step_count: jax.Array  # int32: steps in current episode
+    episode_return: jax.Array  # float32: running return
+    rng: jax.Array  # per-env PRNG stream (reset randomness)
+
+
+@struct.dataclass
+class StepOut:
+    """Per-step results the training loop consumes."""
+
+    next_obs: jax.Array  # pre-reset next observation (what the buffer stores)
+    reward: jax.Array
+    terminated: jax.Array  # float 0/1: Bellman done mask (not truncation)
+    ended: jax.Array  # bool: episode finished; env auto-reset
+    final_return: jax.Array  # episode return; meaningful when `ended`
+
+
+class PendulumJax:
+    """Pendulum swing-up, pure jnp, auto-resetting."""
+
+    obs_dim = 3
+    act_dim = 1
+    act_limit = 2.0
+    max_episode_steps = 200
+
+    max_speed = 8.0
+    dt = 0.05
+    g = 10.0
+    m = 1.0
+    length = 1.0
+
+    @classmethod
+    def _obs(cls, theta, theta_dot):
+        return jnp.stack([jnp.cos(theta), jnp.sin(theta), theta_dot], axis=-1)
+
+    @classmethod
+    def reset(cls, key: jax.Array) -> EnvState:
+        k_theta, k_vel, k_next = jax.random.split(key, 3)
+        theta = jax.random.uniform(k_theta, (), minval=-jnp.pi, maxval=jnp.pi)
+        theta_dot = jax.random.uniform(k_vel, (), minval=-1.0, maxval=1.0)
+        return EnvState(
+            inner=(theta, theta_dot),
+            obs=cls._obs(theta, theta_dot),
+            step_count=jnp.int32(0),
+            episode_return=jnp.float32(0.0),
+            rng=k_next,
+        )
+
+    @classmethod
+    def step(cls, state: EnvState, action: jax.Array):
+        theta, theta_dot = state.inner
+        u = jnp.clip(action[..., 0], -cls.act_limit, cls.act_limit)
+        angle = ((theta + jnp.pi) % (2 * jnp.pi)) - jnp.pi  # normalize
+        reward = -(angle**2 + 0.1 * theta_dot**2 + 0.001 * u**2)
+
+        theta_dot = theta_dot + cls.dt * (
+            3.0 * cls.g / (2.0 * cls.length) * jnp.sin(theta)
+            + 3.0 / (cls.m * cls.length**2) * u
+        )
+        theta_dot = jnp.clip(theta_dot, -cls.max_speed, cls.max_speed)
+        theta = theta + cls.dt * theta_dot
+
+        step_count = state.step_count + 1
+        ended = step_count >= cls.max_episode_steps  # truncation only
+
+        stepped = EnvState(
+            inner=(theta, theta_dot),
+            obs=cls._obs(theta, theta_dot),
+            step_count=step_count,
+            episode_return=state.episode_return + reward,
+            rng=state.rng,
+        )
+        fresh = cls.reset(state.rng)
+        next_state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(ended, a, b), fresh, stepped
+        )
+        out = StepOut(
+            next_obs=stepped.obs,
+            reward=reward,
+            terminated=jnp.float32(0.0),  # pendulum never terminates
+            ended=ended,
+            final_return=stepped.episode_return,
+        )
+        return next_state, out
+
+
+ON_DEVICE_ENVS = {"Pendulum-v1": PendulumJax}
+
+
+def get_on_device_env(name: str):
+    """Registry lookup; None when the task has no pure-JAX twin (host
+    envs remain the general path)."""
+    return ON_DEVICE_ENVS.get(name)

@@ -1,2 +1,3 @@
 from torch_actor_critic_tpu.sac.losses import actor_loss, alpha_loss, critic_loss  # noqa: F401
 from torch_actor_critic_tpu.sac.algorithm import SAC  # noqa: F401
+from torch_actor_critic_tpu.sac.ondevice import OnDeviceLoop  # noqa: F401

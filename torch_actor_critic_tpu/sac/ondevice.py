@@ -1,0 +1,185 @@
+"""Fully-fused on-device training: env + replay + learner in ONE program.
+
+The reference's throughput ceiling is its host loop — one Python
+``env.step`` and one buffer op per step, a gradient step crossing the
+host/native boundary several times (ref ``sac/algorithm.py:220-283``).
+The host :class:`~torch_actor_critic_tpu.sac.trainer.Trainer` already
+batches that boundary to ~2 transfers per window; this module removes
+it entirely for envs with a pure-JAX twin
+(:mod:`torch_actor_critic_tpu.envs.ondevice`): an *entire epoch* —
+vectorized env stepping, policy sampling, replay pushes, and every
+gradient burst — is one ``lax.scan`` under one ``jit``, the
+Podracer/"anakin" topology (PAPERS.md) where nothing leaves the chip
+until the epoch's metrics.
+
+Capability **extension**: the reference cannot express this (its
+physics is host C code). The algorithm inside is byte-identical SAC —
+the same :meth:`SAC.update_burst` the host trainer dispatches.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+
+from torch_actor_critic_tpu.buffer.replay import init_replay_buffer, push
+from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
+from torch_actor_critic_tpu.envs.ondevice import EnvState
+from torch_actor_critic_tpu.sac.algorithm import SAC
+
+Metrics = t.Dict[str, jax.Array]
+
+
+class OnDeviceLoop:
+    """Collect+update loop compiled end-to-end for one device.
+
+    ``n_envs`` pure-JAX envs step in a vmapped batch; every
+    ``update_every`` steps their transitions are pushed and
+    ``update_every`` gradient steps run — the reference's cadence
+    (ref ``sac/algorithm.py:273-283``) with zero host involvement.
+    """
+
+    def __init__(self, sac: SAC, env_cls, n_envs: int = 16):
+        self.sac = sac
+        self.env = env_cls
+        self.n_envs = n_envs
+        self._epoch_fns: dict = {}
+
+    # ------------------------------------------------------------------ init
+
+    def init(
+        self, key: jax.Array, buffer_capacity: int = 1_000_000
+    ) -> t.Tuple[TrainState, BufferState, EnvState, jax.Array]:
+        k_state, k_envs, k_act = jax.random.split(key, 3)
+        env_states = jax.vmap(self.env.reset)(
+            jax.random.split(k_envs, self.n_envs)
+        )
+        train_state = self.sac.init_state(
+            k_state, jnp.zeros((self.env.obs_dim,))
+        )
+        buffer = init_replay_buffer(
+            buffer_capacity,
+            jax.ShapeDtypeStruct((self.env.obs_dim,), jnp.float32),
+            self.env.act_dim,
+        )
+        return train_state, buffer, env_states, k_act
+
+    # ----------------------------------------------------------------- epoch
+
+    def _collect_window(self, params, env_states, act_key, length, warmup):
+        """``length`` vectorized env steps; returns transitions with
+        leading axes (length, n_envs) plus episode-completion stats."""
+        env = self.env
+
+        def step_fn(carry, _):
+            es, key = carry
+            key, k_act = jax.random.split(key)
+            obs = es.obs
+            if warmup:
+                actions = jax.random.uniform(
+                    k_act,
+                    (self.n_envs, env.act_dim),
+                    minval=-env.act_limit,
+                    maxval=env.act_limit,
+                )
+            else:
+                actions, _ = self.sac.actor_def.apply(
+                    params, obs, k_act, with_logprob=False
+                )
+            es, out = jax.vmap(env.step)(es, actions)
+            transition = Batch(
+                states=obs,
+                actions=actions,
+                rewards=out.reward,
+                next_states=out.next_obs,
+                done=out.terminated,
+            )
+            ended = out.ended.astype(jnp.float32)
+            stats = (jnp.sum(ended), jnp.sum(ended * out.final_return))
+            return (es, key), (transition, stats)
+
+        (env_states, act_key), (transitions, stats) = jax.lax.scan(
+            step_fn, (env_states, act_key), xs=None, length=length
+        )
+        n_done = jnp.sum(stats[0])
+        sum_ret = jnp.sum(stats[1])
+        return env_states, act_key, transitions, n_done, sum_ret
+
+    def _build_epoch(self, steps: int, update_every: int, warmup: bool):
+        n_windows, rem = divmod(steps, update_every)
+        if rem:
+            raise ValueError(f"steps={steps} not a multiple of update_every={update_every}")
+
+        def epoch(train_state, buffer, env_states, act_key):
+            def window(carry, _):
+                ts, buf, es, key = carry
+                es, key, transitions, n_done, sum_ret = self._collect_window(
+                    ts.actor_params, es, key, update_every, warmup
+                )
+                # (update_every, n_envs, ...) -> one flat chunk
+                chunk = jax.tree_util.tree_map(
+                    lambda x: x.reshape((-1,) + x.shape[2:]), transitions
+                )
+                if warmup:
+                    buf = push(buf, chunk)
+                    m = {
+                        "loss_q": jnp.float32(0.0),
+                        "loss_pi": jnp.float32(0.0),
+                    }
+                else:
+                    ts, buf, m = self.sac.update_burst(
+                        ts, buf, chunk, update_every
+                    )
+                stats = {
+                    "loss_q": m["loss_q"],
+                    "loss_pi": m["loss_pi"],
+                    "episodes": n_done,
+                    "return_sum": sum_ret,
+                }
+                return (ts, buf, es, key), stats
+
+            (train_state, buffer, env_states, act_key), stats = jax.lax.scan(
+                window,
+                (train_state, buffer, env_states, act_key),
+                xs=None,
+                length=n_windows,
+            )
+            episodes = jnp.sum(stats["episodes"])
+            metrics = {
+                "loss_q": jnp.mean(stats["loss_q"]),
+                "loss_pi": jnp.mean(stats["loss_pi"]),
+                "episodes": episodes,
+                # NaN, not 0, when nothing finished: for reward-negative
+                # tasks a silent 0 would read as a perfect score.
+                "reward": jnp.where(
+                    episodes > 0,
+                    jnp.sum(stats["return_sum"]) / jnp.maximum(episodes, 1.0),
+                    jnp.float32(jnp.nan),
+                ),
+            }
+            return train_state, buffer, env_states, act_key, metrics
+
+        return jax.jit(epoch, donate_argnums=(0, 1))
+
+    def epoch(
+        self,
+        train_state: TrainState,
+        buffer: BufferState,
+        env_states: EnvState,
+        act_key: jax.Array,
+        steps: int,
+        update_every: int = 50,
+        warmup: bool = False,
+    ):
+        """Run ``steps`` vectorized env steps (x ``n_envs`` transitions)
+        with a fused gradient burst per ``update_every`` window — one
+        device dispatch for the whole call. ``warmup=True`` collects
+        with uniform-random actions and skips updates (the reference's
+        ``start_steps``/``update_after`` phase, ref
+        ``sac/algorithm.py:227-228,273``)."""
+        sig = (steps, update_every, warmup)
+        if sig not in self._epoch_fns:
+            self._epoch_fns[sig] = self._build_epoch(*sig)
+        return self._epoch_fns[sig](train_state, buffer, env_states, act_key)
