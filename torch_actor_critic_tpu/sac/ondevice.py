@@ -24,6 +24,8 @@ import typing as t
 import jax
 import jax.numpy as jnp
 
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
 from torch_actor_critic_tpu.buffer.replay import init_replay_buffer, push
 from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
 from torch_actor_critic_tpu.envs.ondevice import EnvState
@@ -33,18 +35,33 @@ Metrics = t.Dict[str, jax.Array]
 
 
 class OnDeviceLoop:
-    """Collect+update loop compiled end-to-end for one device.
+    """Collect+update loop compiled end-to-end — one device or a mesh.
 
     ``n_envs`` pure-JAX envs step in a vmapped batch; every
     ``update_every`` steps their transitions are pushed and
     ``update_every`` gradient steps run — the reference's cadence
     (ref ``sac/algorithm.py:273-283``) with zero host involvement.
+
+    With a ``mesh``, the loop data-parallelizes like
+    :class:`~torch_actor_critic_tpu.parallel.dp.DataParallelSAC`:
+    every ``dp`` slice runs its own ``n_envs`` envs against its own
+    replay shard (leading device axis on env/buffer state), params stay
+    replicated, gradients ``pmean`` over ICI inside the fused bursts —
+    the whole multi-chip epoch is still ONE dispatch. This is the
+    TPU-native endpoint of the reference's per-rank env+buffer MPI
+    layout (SURVEY.md §2 "Parallelism strategies"), minus its hosts.
     """
 
-    def __init__(self, sac: SAC, env_cls, n_envs: int = 16):
+    AXIS = "dp"
+
+    def __init__(
+        self, sac: SAC, env_cls, n_envs: int = 16, mesh: Mesh | None = None
+    ):
         self.sac = sac
         self.env = env_cls
-        self.n_envs = n_envs
+        self.n_envs = n_envs  # per dp slice when mesh is given
+        self.mesh = mesh
+        self.n_dp = mesh.shape["dp"] if mesh is not None else 1
         self._epoch_fns: dict = {}
 
     # ------------------------------------------------------------------ init
@@ -52,10 +69,9 @@ class OnDeviceLoop:
     def init(
         self, key: jax.Array, buffer_capacity: int = 1_000_000
     ) -> t.Tuple[TrainState, BufferState, EnvState, jax.Array]:
+        """``buffer_capacity`` is per dp slice, matching the reference's
+        per-worker buffers (ref ``main.py:140-141``)."""
         k_state, k_envs, k_act = jax.random.split(key, 3)
-        env_states = jax.vmap(self.env.reset)(
-            jax.random.split(k_envs, self.n_envs)
-        )
         train_state = self.sac.init_state(
             k_state, jnp.zeros((self.env.obs_dim,))
         )
@@ -64,6 +80,28 @@ class OnDeviceLoop:
             jax.ShapeDtypeStruct((self.env.obs_dim,), jnp.float32),
             self.env.act_dim,
         )
+        if self.mesh is None:
+            env_states = jax.vmap(self.env.reset)(
+                jax.random.split(k_envs, self.n_envs)
+            )
+            return train_state, buffer, env_states, k_act
+
+        env_states = jax.vmap(jax.vmap(self.env.reset))(
+            jax.random.split(k_envs, self.n_dp * self.n_envs).reshape(
+                self.n_dp, self.n_envs
+            )
+        )
+        dp_sharding = NamedSharding(self.mesh, P("dp"))
+        rep = NamedSharding(self.mesh, P())
+        put = jax.tree_util.tree_map
+        train_state = put(lambda x: jax.device_put(x, rep), train_state)
+        buffer = put(
+            lambda x: jax.device_put(
+                jnp.broadcast_to(x[None], (self.n_dp,) + x.shape), dp_sharding
+            ),
+            buffer,
+        )
+        env_states = put(lambda x: jax.device_put(x, dp_sharding), env_states)
         return train_state, buffer, env_states, k_act
 
     # ----------------------------------------------------------------- epoch
@@ -107,61 +145,137 @@ class OnDeviceLoop:
         sum_ret = jnp.sum(stats[1])
         return env_states, act_key, transitions, n_done, sum_ret
 
+    def _epoch_body(
+        self,
+        train_state,
+        buffer,
+        env_states,
+        act_key,
+        n_windows: int,
+        update_every: int,
+        warmup: bool,
+        axis_name: str | None = None,
+    ):
+        """Scan of windows; returns raw stats (losses averaged, episode
+        counts/returns summed locally — callers reduce across devices)."""
+
+        def window(carry, _):
+            ts, buf, es, key = carry
+            es, key, transitions, n_done, sum_ret = self._collect_window(
+                ts.actor_params, es, key, update_every, warmup
+            )
+            # (update_every, n_envs, ...) -> one flat chunk
+            chunk = jax.tree_util.tree_map(
+                lambda x: x.reshape((-1,) + x.shape[2:]), transitions
+            )
+            if warmup:
+                buf = push(buf, chunk)
+                m = {
+                    "loss_q": jnp.float32(0.0),
+                    "loss_pi": jnp.float32(0.0),
+                }
+            else:
+                ts, buf, m = self.sac.update_burst(
+                    ts, buf, chunk, update_every, axis_name=axis_name
+                )
+            stats = {
+                "loss_q": m["loss_q"],
+                "loss_pi": m["loss_pi"],
+                "episodes": n_done,
+                "return_sum": sum_ret,
+            }
+            return (ts, buf, es, key), stats
+
+        (train_state, buffer, env_states, act_key), stats = jax.lax.scan(
+            window,
+            (train_state, buffer, env_states, act_key),
+            xs=None,
+            length=n_windows,
+        )
+        raw = {
+            "loss_q": jnp.mean(stats["loss_q"]),
+            "loss_pi": jnp.mean(stats["loss_pi"]),
+            "episodes": jnp.sum(stats["episodes"]),
+            "return_sum": jnp.sum(stats["return_sum"]),
+        }
+        return train_state, buffer, env_states, act_key, raw
+
+    @staticmethod
+    def _finalize_metrics(raw: Metrics) -> Metrics:
+        episodes = raw["episodes"]
+        return {
+            "loss_q": raw["loss_q"],
+            "loss_pi": raw["loss_pi"],
+            "episodes": episodes,
+            # NaN, not 0, when nothing finished: for reward-negative
+            # tasks a silent 0 would read as a perfect score.
+            "reward": jnp.where(
+                episodes > 0,
+                raw["return_sum"] / jnp.maximum(episodes, 1.0),
+                jnp.float32(jnp.nan),
+            ),
+        }
+
     def _build_epoch(self, steps: int, update_every: int, warmup: bool):
         n_windows, rem = divmod(steps, update_every)
         if rem:
             raise ValueError(f"steps={steps} not a multiple of update_every={update_every}")
 
-        def epoch(train_state, buffer, env_states, act_key):
-            def window(carry, _):
-                ts, buf, es, key = carry
-                es, key, transitions, n_done, sum_ret = self._collect_window(
-                    ts.actor_params, es, key, update_every, warmup
-                )
-                # (update_every, n_envs, ...) -> one flat chunk
-                chunk = jax.tree_util.tree_map(
-                    lambda x: x.reshape((-1,) + x.shape[2:]), transitions
-                )
-                if warmup:
-                    buf = push(buf, chunk)
-                    m = {
-                        "loss_q": jnp.float32(0.0),
-                        "loss_pi": jnp.float32(0.0),
-                    }
-                else:
-                    ts, buf, m = self.sac.update_burst(
-                        ts, buf, chunk, update_every
-                    )
-                stats = {
-                    "loss_q": m["loss_q"],
-                    "loss_pi": m["loss_pi"],
-                    "episodes": n_done,
-                    "return_sum": sum_ret,
-                }
-                return (ts, buf, es, key), stats
+        if self.mesh is None:
 
-            (train_state, buffer, env_states, act_key), stats = jax.lax.scan(
-                window,
-                (train_state, buffer, env_states, act_key),
-                xs=None,
-                length=n_windows,
+            def epoch(train_state, buffer, env_states, act_key):
+                ts, buf, es, key, raw = self._epoch_body(
+                    train_state, buffer, env_states, act_key,
+                    n_windows, update_every, warmup,
+                )
+                return ts, buf, es, key, self._finalize_metrics(raw)
+
+            return jax.jit(epoch, donate_argnums=(0, 1))
+
+        mesh = self.mesh
+        axis = OnDeviceLoop.AXIS
+
+        def dp_body(train_state, buffer, env_states, act_key):
+            buffer = jax.tree_util.tree_map(lambda x: x[0], buffer)
+            env_states = jax.tree_util.tree_map(lambda x: x[0], env_states)
+            dev = jax.lax.axis_index(axis)
+            # Per-device streams (the reference's per-rank seeds, ref
+            # sac/algorithm.py:203-205); env randomness already diverges
+            # via the per-env rng in EnvState.
+            local = train_state.replace(
+                rng=jax.random.fold_in(train_state.rng, dev)
             )
-            episodes = jnp.sum(stats["episodes"])
-            metrics = {
-                "loss_q": jnp.mean(stats["loss_q"]),
-                "loss_pi": jnp.mean(stats["loss_pi"]),
-                "episodes": episodes,
-                # NaN, not 0, when nothing finished: for reward-negative
-                # tasks a silent 0 would read as a perfect score.
-                "reward": jnp.where(
-                    episodes > 0,
-                    jnp.sum(stats["return_sum"]) / jnp.maximum(episodes, 1.0),
-                    jnp.float32(jnp.nan),
-                ),
+            key = jax.random.fold_in(act_key, dev)
+            ts, buf, es, _, raw = self._epoch_body(
+                local, buffer, env_states, key,
+                n_windows, update_every, warmup, axis_name=axis,
+            )
+            # pmean'd grads keep params replicated; emit a replicated rng
+            # and act key derived from the pre-epoch values.
+            ts = ts.replace(
+                rng=jax.random.fold_in(train_state.rng, jnp.uint32(0xB0057))
+            )
+            key_out = jax.random.fold_in(act_key, jnp.uint32(0xB0057))
+            raw = {
+                "loss_q": jax.lax.pmean(raw["loss_q"], axis),
+                "loss_pi": jax.lax.pmean(raw["loss_pi"], axis),
+                "episodes": jax.lax.psum(raw["episodes"], axis),
+                "return_sum": jax.lax.psum(raw["return_sum"], axis),
             }
-            return train_state, buffer, env_states, act_key, metrics
+            buf = jax.tree_util.tree_map(lambda x: x[None], buf)
+            es = jax.tree_util.tree_map(lambda x: x[None], es)
+            return ts, buf, es, key_out, self._finalize_metrics(raw)
 
-        return jax.jit(epoch, donate_argnums=(0, 1))
+        dp_spec, rep = P(axis), P()
+        mapped = jax.shard_map(
+            dp_body,
+            mesh=mesh,
+            in_specs=(rep, dp_spec, dp_spec, rep),
+            out_specs=(rep, dp_spec, dp_spec, rep, rep),
+            axis_names={axis},
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
 
     def epoch(
         self,
@@ -183,3 +297,94 @@ class OnDeviceLoop:
         if sig not in self._epoch_fns:
             self._epoch_fns[sig] = self._build_epoch(*sig)
         return self._epoch_fns[sig](train_state, buffer, env_states, act_key)
+
+
+def train_on_device(
+    env_name: str,
+    config,
+    mesh=None,
+    tracker=None,
+    checkpointer=None,
+    seed: int = 0,
+) -> dict:
+    """Host driver for the fused loop: one device dispatch per epoch,
+    host work = logging + checkpoints. The CLI routes here for
+    ``--on-device true`` (envs with a pure-JAX twin only).
+
+    Env steps per epoch are ``steps_per_epoch x on_device_envs x dp``;
+    the warmup phase covers ``start_steps`` policy-free steps (ref
+    ``sac/algorithm.py:227-228``). Checkpoints persist learner + buffer
+    state (env states re-reset on resume — episodes are seconds long).
+    """
+    import numpy as np
+
+    from torch_actor_critic_tpu.envs.ondevice import (
+        ON_DEVICE_ENVS,
+        get_on_device_env,
+    )
+    from torch_actor_critic_tpu.models import Actor, DoubleCritic
+    from torch_actor_critic_tpu.parallel.distributed import is_coordinator
+
+    env_cls = get_on_device_env(env_name)
+    if env_cls is None:
+        raise ValueError(
+            f"{env_name!r} has no pure-JAX twin; on-device training "
+            f"supports {sorted(ON_DEVICE_ENVS)}"
+        )
+    sac = SAC(
+        config,
+        Actor(
+            act_dim=env_cls.act_dim,
+            hidden_sizes=config.hidden_sizes,
+            act_limit=env_cls.act_limit,
+        ),
+        DoubleCritic(hidden_sizes=config.hidden_sizes, num_qs=config.num_qs),
+        env_cls.act_dim,
+    )
+    loop = OnDeviceLoop(sac, env_cls, n_envs=config.on_device_envs, mesh=mesh)
+    state, buffer, env_states, act_key = loop.init(
+        jax.random.key(seed), buffer_capacity=config.buffer_size
+    )
+    start_epoch = 0
+    if checkpointer is not None and checkpointer.latest_epoch() is not None:
+        state, buffer, meta = checkpointer.restore(state, buffer)
+        start_epoch = int(meta["epoch"]) + 1
+
+    warmup_steps = max(
+        config.update_every,
+        (config.start_steps // config.update_every) * config.update_every,
+    )
+    if start_epoch == 0:
+        state, buffer, env_states, act_key, _ = loop.epoch(
+            state, buffer, env_states, act_key, steps=warmup_steps, warmup=True
+        )
+
+    import time
+
+    metrics: dict = {}
+    for e in range(start_epoch, start_epoch + config.epochs):
+        t0 = time.time()
+        state, buffer, env_states, act_key, m = loop.epoch(
+            state,
+            buffer,
+            env_states,
+            act_key,
+            steps=config.steps_per_epoch,
+            update_every=config.update_every,
+        )
+        jax.block_until_ready(m["loss_q"])
+        dt = time.time() - t0
+        metrics = {k: float(v) for k, v in m.items()}
+        metrics["env_steps_per_sec"] = (
+            config.steps_per_epoch * loop.n_envs * loop.n_dp / dt
+        )
+        metrics["grad_steps_per_sec"] = config.steps_per_epoch / dt
+        if tracker is not None and is_coordinator():
+            tracker.log_metrics(metrics, e)
+        if checkpointer is not None and e % config.save_every == 0:
+            checkpointer.save(e, state, buffer, extra={"config": config.to_json()})
+        if not np.isfinite(metrics["loss_q"]):
+            raise FloatingPointError(f"loss_q diverged at epoch {e}: {metrics}")
+    if checkpointer is not None:
+        checkpointer.wait()
+    return metrics

@@ -138,6 +138,20 @@ def main(argv=None):
     checkpointer = Checkpointer(
         tracker.artifact_path("checkpoints"), save_buffer=args.save_buffer
     )
+    if config.on_device:
+        from torch_actor_critic_tpu.sac.ondevice import train_on_device
+
+        logger.info(
+            "on-device training: %s on mesh %s (run %s)",
+            env_name, dict(mesh.shape), tracker.run_id,
+        )
+        metrics = train_on_device(
+            env_name, config,
+            mesh=mesh, tracker=tracker, checkpointer=checkpointer,
+            seed=args.seed,
+        )
+        logger.info("final metrics: %s", metrics)
+        return metrics
     trainer = Trainer(
         env_name,
         config,

@@ -73,6 +73,13 @@ class SACConfig:
     seq_num_heads: int = 4
     seq_num_layers: int = 2
 
+    # Fully-fused on-device training (sac/ondevice.py): env + replay +
+    # learner compiled into one program per epoch. Only for envs with a
+    # pure-JAX twin (envs/ondevice.py registry). on_device_envs is the
+    # vectorized env batch per dp slice.
+    on_device: bool = False
+    on_device_envs: int = 16
+
     # Observation normalization (the reference ships a Welford
     # normalizer as dead code, ref sac/utils.py:27-65; here it's a
     # usable option).
