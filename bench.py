@@ -6,9 +6,22 @@ reference run configuration (alpha=0.2 fixed, gamma=0.99, polyak=0.995,
 batch 64, hidden [256,256], lr 3e-4, ``torch.set_num_threads(2)`` as in
 ref ``main.py:130``) on HalfCheetah-v3 dimensions (obs 17, act 6).
 
-Prints ONE JSON line:
+Prints exactly ONE JSON line on stdout:
     {"metric": "sac_grad_steps_per_sec", "value": N, "unit":
-     "steps/sec", "vs_baseline": ratio_vs_torch_cpu}
+     "steps/sec", "vs_baseline": ratio_vs_torch_cpu, ...}
+Extra keys: backend, device_kind, mfu, flops_per_step, sweep (batch/
+width scaling), on_device (fused env+update loop throughput), and —
+on any failure — "error"/"diagnostics" instead of a silent traceback.
+
+Robustness contract (round-2 hardening):
+  * The accelerator backend is preflighted in a SUBPROCESS with a
+    bounded timeout and retry/backoff — a hung TPU plugin (the round-1
+    failure mode: "Unable to initialize backend 'axon'") cannot wedge
+    the parent, which falls back to CPU and still emits a line.
+  * The TPU benchmark runs BEFORE the torch baseline so an accelerator
+    number is recorded even if the baseline path breaks.
+  * Every stage is individually guarded; main() never raises and
+    always exits 0 with a parseable JSON line.
 
 The TPU number is measured through the real training path — the fused
 ``update_burst`` (push + 50 sampled gradient steps per dispatch) over
@@ -16,17 +29,137 @@ the HBM replay buffer, exactly what the trainer runs.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 OBS_DIM, ACT_DIM = 17, 6
 BATCH = 64
 HIDDEN = (256, 256)
 BURST = 50
 
+# Pinned fallback: reference-style torch-CPU SAC measured on this image
+# (2 threads, ref main.py:130 config) on 2026-07-29. Used for
+# vs_baseline only if the live baseline measurement fails.
+TORCH_CPU_FALLBACK_SPS = 143.1
 
-def bench_tpu() -> float:
+# Peak bf16 FLOP/s per chip by TPU generation (public figures); MFU is
+# reported against the matching entry (override: TAC_PEAK_FLOPS env).
+PEAK_FLOPS_BY_KIND = [
+    ("v6", 918e12),
+    ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+# The axon sitecustomize re-registers "axon,cpu" over JAX_PLATFORMS at
+# jax import, so a CPU probe/fallback must force the platform via
+# jax.config AFTER import but BEFORE backend init (same countermeasure
+# as tests/conftest.py).
+_PROBE_SRC = """
+import json, time, sys
+t0 = time.time()
+import jax, jax.numpy as jnp
+if len(sys.argv) > 1 and sys.argv[1] == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+devs = jax.devices()
+x = jnp.ones((256, 256), jnp.float32)
+jax.block_until_ready(x @ x)
+print(json.dumps({
+    "platform": devs[0].platform,
+    "device_kind": devs[0].device_kind,
+    "n_devices": len(devs),
+    "init_seconds": round(time.time() - t0, 1),
+}))
+"""
+
+
+def _ensure_platform(platform):
+    """Force the chosen platform in-process before any backend init."""
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+
+def log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def preflight_backend():
+    """Probe the default (accelerator) backend in a subprocess with
+    retry/backoff; on persistent failure probe CPU. Returns
+    (info_dict, diagnostics)."""
+    diags = []
+    attempts = [(90, 10), (120, 20), (150, 0)]
+    if os.environ.get("TAC_BENCH_PLATFORM") == "cpu":
+        attempts = []  # operator override: skip straight to CPU
+    for attempt, (timeout_s, backoff_s) in enumerate(attempts):
+        try:
+            t0 = time.time()
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if proc.returncode == 0:
+                info = json.loads(proc.stdout.strip().splitlines()[-1])
+                log(f"preflight ok: {info}")
+                return info, diags
+            diags.append({
+                "attempt": attempt, "rc": proc.returncode,
+                "stderr_tail": proc.stderr[-500:],
+                "elapsed": round(time.time() - t0, 1),
+            })
+            log(f"preflight attempt {attempt} rc={proc.returncode}")
+        except subprocess.TimeoutExpired:
+            diags.append({"attempt": attempt, "error": f"timeout after {timeout_s}s"})
+            log(f"preflight attempt {attempt} timed out ({timeout_s}s)")
+        except Exception as e:  # noqa: BLE001 — preflight must not raise
+            diags.append({"attempt": attempt, "error": repr(e)})
+        if backoff_s:
+            time.sleep(backoff_s)
+
+    log("accelerator preflight failed; falling back to CPU backend")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC, "cpu"],
+            capture_output=True, text=True, timeout=120,
+        )
+        info = json.loads(proc.stdout.strip().splitlines()[-1])
+        log(f"cpu fallback preflight ok: {info}")
+    except Exception as e:  # noqa: BLE001
+        diags.append({"cpu_fallback_error": repr(e)})
+        info = {"platform": "none", "device_kind": "none", "n_devices": 0}
+    return info, diags
+
+
+def sac_flops_per_step(batch=BATCH, hidden=HIDDEN, obs=OBS_DIM, act=ACT_DIM):
+    """Analytic FLOPs for one SAC gradient step (critic+policy update),
+    dense matmul MACs x2, batch-scaled. Backward through a layer costs
+    ~2x its forward; the frozen-critic pass in the policy loss only
+    needs input grads (~1x forward extra). Elementwise/Adam/polyak
+    terms are negligible and omitted."""
+    def mlp_macs(sizes):
+        return sum(a * b for a, b in zip(sizes[:-1], sizes[1:]))
+
+    actor = mlp_macs([obs, *hidden]) + 2 * hidden[-1] * act       # trunk + mu/log_std heads
+    critic = 2 * mlp_macs([obs + act, *hidden, 1])                # twin Q
+    macs = (
+        actor          # pi(s') for the backup (no grad)
+        + critic       # target twin fwd
+        + 3 * critic   # critic twin fwd+bwd
+        + 3 * actor    # actor fwd+bwd (policy loss)
+        + 2 * critic   # critic fwd + input-only bwd (frozen)
+    )
+    return 2 * batch * macs
+
+
+def _make_bench_fn(obs_dim, act_dim, hidden, batch, capacity=1_000_000):
     import jax
     import jax.numpy as jnp
 
@@ -36,45 +169,101 @@ def bench_tpu() -> float:
     from torch_actor_critic_tpu.sac import SAC
     from torch_actor_critic_tpu.utils.config import SACConfig
 
-    cfg = SACConfig(batch_size=BATCH, hidden_sizes=HIDDEN)
-    sac = SAC(cfg, Actor(act_dim=ACT_DIM, hidden_sizes=HIDDEN), DoubleCritic(hidden_sizes=HIDDEN), ACT_DIM)
-    state = sac.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
+    cfg = SACConfig(batch_size=batch, hidden_sizes=hidden)
+    sac = SAC(cfg, Actor(act_dim=act_dim, hidden_sizes=hidden),
+              DoubleCritic(hidden_sizes=hidden), act_dim)
+    state = sac.init_state(jax.random.key(0), jnp.zeros((obs_dim,)))
     buf = init_replay_buffer(
-        1_000_000, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32), ACT_DIM
+        capacity, jax.ShapeDtypeStruct((obs_dim,), jnp.float32), act_dim
     )
 
     def chunk(key, n=BURST):
         ks = jax.random.split(jax.random.key(key), 5)
         return Batch(
-            states=jax.random.normal(ks[0], (n, OBS_DIM)),
-            actions=jnp.tanh(jax.random.normal(ks[1], (n, ACT_DIM))),
+            states=jax.random.normal(ks[0], (n, obs_dim)),
+            actions=jnp.tanh(jax.random.normal(ks[1], (n, act_dim))),
             rewards=jax.random.normal(ks[2], (n,)),
-            next_states=jax.random.normal(ks[3], (n, OBS_DIM)),
+            next_states=jax.random.normal(ks[3], (n, obs_dim)),
             done=jnp.zeros((n,)),
         )
 
     buf = jax.jit(push, donate_argnums=(0,))(buf, chunk(1, 5000))
     burst = jax.jit(sac.update_burst, static_argnums=(3,), donate_argnums=(0, 1))
 
-    # compile + warmup
-    state, buf, m = burst(state, buf, chunk(2), BURST)
+    state, buf, m = burst(state, buf, chunk(2), BURST)  # compile + warmup
     jax.block_until_ready(m)
 
-    n_bursts = 60
-    t0 = time.perf_counter()
-    for i in range(n_bursts):
-        state, buf, m = burst(state, buf, chunk(10 + i), BURST)
-    jax.block_until_ready(m)
-    dt = time.perf_counter() - t0
-    return n_bursts * BURST / dt
+    def run(n_bursts):
+        nonlocal state, buf
+        t0 = time.perf_counter()
+        for i in range(n_bursts):
+            state, buf, m = burst(state, buf, chunk(10 + i), BURST)
+        jax.block_until_ready(m)
+        return n_bursts * BURST / (time.perf_counter() - t0)
+
+    return run
 
 
-def bench_torch_cpu() -> float:
+def bench_accelerator():
+    """Headline number: grad-steps/sec at the reference config through
+    the real fused update_burst path."""
+    run = _make_bench_fn(OBS_DIM, ACT_DIM, HIDDEN, BATCH)
+    run(5)  # extra warmup beyond compile
+    return run(60)
+
+
+def bench_sweep(budget_s=240.0):
+    """Batch/width scaling: shows where the chip stops being
+    latency-bound. Best-effort within a time budget."""
+    results = []
+    t_start = time.time()
+    for batch, hidden in [(512, HIDDEN), (4096, HIDDEN), (4096, (1024, 1024))]:
+        if time.time() - t_start > budget_s:
+            log("sweep budget exhausted; truncating")
+            break
+        try:
+            run = _make_bench_fn(OBS_DIM, ACT_DIM, hidden, batch, capacity=100_000)
+            sps = run(2)  # calibration; re-measure properly only if fast
+            if BURST * 20 / sps < (budget_s - (time.time() - t_start)):
+                sps = run(20)
+            results.append({
+                "batch": batch, "hidden": list(hidden),
+                "grad_steps_per_sec": round(sps, 1),
+                "examples_per_sec": round(sps * batch, 0),
+            })
+            log(f"sweep batch={batch} hidden={hidden}: {sps:.1f} steps/s")
+        except Exception as e:  # noqa: BLE001 — sweep is best-effort
+            results.append({"batch": batch, "hidden": list(hidden), "error": repr(e)})
+    return results
+
+
+def bench_on_device(budget_s=300.0):
+    """Fused on-device env+update loop throughput (envs/ondevice.py):
+    the path the host-loop reference cannot express. Best-effort."""
+    out = {}
+    t_start = time.time()
+    try:
+        from torch_actor_critic_tpu.sac.ondevice import benchmark_on_device
+    except ImportError:
+        return {"error": "benchmark_on_device not available"}
+    for env_name in ("pendulum", "cheetah"):
+        if time.time() - t_start > budget_s:
+            out[env_name] = {"error": "budget exhausted"}
+            continue
+        try:
+            out[env_name] = benchmark_on_device(env_name)
+        except Exception as e:  # noqa: BLE001
+            out[env_name] = {"error": repr(e)}
+    return out
+
+
+def bench_torch_cpu(n_steps=300):
     """Reference-style torch-CPU SAC update (independent implementation
     of the same math: twin-critic Bellman MSE + squashed-Gaussian policy
     loss + polyak), timed per gradient step incl. uniform replay
     sampling — the measured stand-in for the unpublished reference
     baseline."""
+    import numpy as np
     import torch
     import torch.nn as nn
     import torch.nn.functional as F
@@ -159,27 +348,100 @@ def bench_torch_cpu() -> float:
 
     for _ in range(20):  # warmup
         step()
-    n_steps = 300
     t0 = time.perf_counter()
     for _ in range(n_steps):
         step()
     return n_steps / (time.perf_counter() - t0)
 
 
+def peak_flops_for(device_kind):
+    env = os.environ.get("TAC_PEAK_FLOPS")
+    if env:
+        return float(env)
+    kind = (device_kind or "").lower()
+    for tag, peak in PEAK_FLOPS_BY_KIND:
+        if tag in kind:
+            return peak
+    return None
+
+
 def main():
-    torch_sps = bench_torch_cpu()
-    tpu_sps = bench_tpu()
-    print(
-        json.dumps(
-            {
-                "metric": "sac_grad_steps_per_sec",
-                "value": round(tpu_sps, 1),
-                "unit": "steps/sec",
-                "vs_baseline": round(tpu_sps / torch_sps, 2),
-            }
-        )
-    )
+    out = {
+        "metric": "sac_grad_steps_per_sec",
+        "value": None,
+        "unit": "steps/sec",
+        "vs_baseline": None,
+    }
+    diagnostics = []
+
+    # 1. Preflight the accelerator (subprocess; cannot hang the parent).
+    info, pf_diags = preflight_backend()
+    _ensure_platform(info.get("platform"))
+    out["backend"] = info.get("platform")
+    out["device_kind"] = info.get("device_kind")
+    if pf_diags:
+        diagnostics.append({"preflight": pf_diags})
+
+    # 2. Accelerator benchmark FIRST (the number that matters).
+    acc_sps = None
+    if info.get("platform") not in (None, "none"):
+        try:
+            acc_sps = bench_accelerator()
+            out["value"] = round(acc_sps, 1)
+            log(f"accelerator: {acc_sps:.1f} grad-steps/s ({info.get('platform')})")
+        except Exception as e:  # noqa: BLE001 — must still emit JSON
+            diagnostics.append({"accelerator_bench_error": repr(e)})
+            log(f"accelerator bench failed: {e!r}")
+
+    # 3. MFU (analytic FLOPs; negligible-elementwise approximation).
+    flops = sac_flops_per_step()
+    out["flops_per_step"] = flops
+    if acc_sps is not None:
+        peak = peak_flops_for(info.get("device_kind"))
+        out["achieved_flops_per_sec"] = round(acc_sps * flops, 0)
+        if peak:
+            out["mfu"] = round(acc_sps * flops / peak, 5)
+            out["peak_flops_assumed"] = peak
+
+    # 4./5. Accelerator scaling sections: the batch/width sweep and the
+    # fused on-device loop measure chip behavior — on the CPU *fallback*
+    # they are meaningless and can take tens of minutes on a 2-thread
+    # host, delaying the JSON line past harness timeouts. Skip unless
+    # on a real accelerator (TAC_BENCH_FULL=1 overrides for testing).
+    full = info.get("platform") != "cpu" or os.environ.get("TAC_BENCH_FULL") == "1"
+    if acc_sps is not None and full:
+        out["sweep"] = bench_sweep()
+        out["on_device"] = bench_on_device()
+
+    # 6. Torch-CPU baseline LAST; pinned fallback if it breaks.
+    torch_sps = None
+    try:
+        torch_sps = bench_torch_cpu()
+        out["torch_cpu_steps_per_sec"] = round(torch_sps, 1)
+    except Exception as e:  # noqa: BLE001
+        diagnostics.append({"torch_baseline_error": repr(e)})
+        torch_sps = TORCH_CPU_FALLBACK_SPS
+        out["torch_cpu_steps_per_sec"] = torch_sps
+        out["torch_baseline_source"] = "pinned_fallback"
+
+    if acc_sps is not None and torch_sps:
+        out["vs_baseline"] = round(acc_sps / torch_sps, 2)
+
+    if diagnostics:
+        out["diagnostics"] = diagnostics
+    if out["value"] is None:
+        out["error"] = "no accelerator benchmark completed"
+
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 — last-resort structured line
+        print(json.dumps({
+            "metric": "sac_grad_steps_per_sec", "value": None,
+            "unit": "steps/sec", "vs_baseline": None,
+            "error": f"fatal: {e!r}",
+        }), flush=True)
+    sys.exit(0)

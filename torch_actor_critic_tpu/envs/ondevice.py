@@ -127,7 +127,215 @@ class PendulumJax:
         return next_state, out
 
 
-ON_DEVICE_ENVS = {"Pendulum-v1": PendulumJax}
+class CheetahRunJax:
+    """Planar cheetah locomotion, pure jnp — the on-device twin of the
+    headline benchmark env (BASELINE.md configs 2/4).
+
+    Interface-identical to gymnasium ``HalfCheetah-v3`` (the env the
+    reference trains through its host loop, ref ``main.py:167``):
+
+    - ``qpos`` = [x, z, pitch, bthigh, bshin, bfoot, fthigh, fshin,
+      ffoot] (9), ``qvel`` the matching rates (9);
+    - obs = ``concat(qpos[1:], qvel)`` -> **17** (x excluded, as in
+      gym's ``exclude_current_positions_from_observation=True``);
+    - 6 joint torques in [-1, 1];
+    - reward = forward_velocity - 0.1 * ||action||^2 (gym's
+      ``forward_reward_weight=1, ctrl_cost_weight=0.1``);
+    - dt = 0.05 via 5 substeps of 0.01 (gym: frame_skip 5 x 0.01);
+    - never terminates; truncates at 1000 steps.
+
+    The *dynamics* are a simplified articulated model, NOT
+    MuJoCo-parity (MJX/Brax are unavailable in this image): joints are
+    torque-driven spring-dampers, feet get a smooth ground-contact
+    weight from leg kinematics, and stance-phase thigh sweep produces
+    forward traction, so the learnable skill — rhythmic leg swings
+    timed to contact — has the same structure as the MuJoCo task.
+    Compute shape per step (obs 17 / act 6 / 6 actuated DoF) matches
+    the real env, so throughput and scaling measurements transfer;
+    return values do not. Physics-parity runs use the host-loop path
+    with real MuJoCo (``envs/wrappers.py``).
+    """
+
+    obs_dim = 17
+    act_dim = 6
+    act_limit = 1.0
+    max_episode_steps = 1000
+
+    dt = 0.05
+    n_substeps = 5
+    gravity = 9.81
+    mass = 14.0  # cheetah torso+legs, roughly MuJoCo's total
+
+    # Per-joint torque gears and spring/damping (joint-accel units),
+    # order [bthigh, bshin, bfoot, fthigh, fshin, ffoot]. Tuned for a
+    # ~10 rad/s natural frequency so gait-rate commands (6-16 rad/s)
+    # are not attenuated; gear ratios follow HalfCheetah's
+    # back>front ordering but the ankles are strengthened so the
+    # swing-lift DoF stays controllable (deliberate deviation — these
+    # are surrogate dynamics).
+    gear = jnp.array([130.0, 100.0, 90.0, 130.0, 100.0, 70.0])
+    joint_k = jnp.array([100.0, 100.0, 100.0, 100.0, 100.0, 100.0])
+    joint_d = jnp.array([12.0, 12.0, 12.0, 12.0, 12.0, 12.0])
+    joint_range = jnp.array([1.05, 1.1, 0.8, 1.0, 1.2, 0.9])
+
+    z_rest = 0.6  # standing torso height
+    ground_k = 4000.0
+    ground_d = 100.0
+    friction_mu = 0.8
+    slip_v0 = 0.5  # tanh slip-velocity scale for the friction law
+    pitch_k = 40.0
+    pitch_d = 6.0
+
+    @classmethod
+    def _obs(cls, qpos, qvel):
+        return jnp.concatenate([qpos[1:], qvel])
+
+    @classmethod
+    def _foot_heights(cls, qpos):
+        """Smooth kinematic proxy for foot clearance: thigh+shin
+        flexion shortens the leg a little; the ankle joint retracts the
+        foot outright (the swing-phase lift DoF — independent of the
+        sweep angle, so stance and sweep are separately controllable,
+        which is what makes a propulsive gait expressible)."""
+        z, pitch = qpos[1], qpos[2]
+        bthigh, bshin, bfoot = qpos[3], qpos[4], qpos[5]
+        fthigh, fshin, ffoot = qpos[6], qpos[7], qpos[8]
+        leg_len = cls.z_rest
+        h_back = (
+            z
+            - leg_len * jnp.cos(bthigh + 0.5 * bshin + 0.3 * pitch)
+            + 0.25 * (1.0 - jnp.cos(bfoot))
+        )
+        h_front = (
+            z
+            - leg_len * jnp.cos(fthigh + 0.5 * fshin - 0.3 * pitch)
+            + 0.25 * (1.0 - jnp.cos(ffoot))
+        )
+        return jnp.stack([h_back, h_front])
+
+    @classmethod
+    def _substep(cls, qpos, qvel, u, h):
+        x, z, pitch = qpos[0], qpos[1], qpos[2]
+        joints = qpos[3:]
+        vx, vz, pitch_dot = qvel[0], qvel[1], qvel[2]
+        joint_vel = qvel[3:]
+
+        # Actuated spring-damper joints with soft range limits.
+        over = jnp.maximum(jnp.abs(joints) - cls.joint_range, 0.0)
+        limit_torque = -300.0 * over * jnp.sign(joints)
+        joint_acc = (
+            cls.gear * u
+            - cls.joint_k * joints
+            - cls.joint_d * joint_vel
+            + limit_torque
+        )
+
+        # Ground contact: smooth stance weight per foot.
+        foot_h = cls._foot_heights(qpos)
+        contact = jax.nn.sigmoid(-foot_h / 0.03)
+        penetration = jnp.maximum(-foot_h, 0.0)
+        normal = contact * (cls.ground_k * penetration - cls.ground_d * vz)
+        normal = jnp.maximum(normal, 0.0)
+
+        # Stick-slip ground friction: force opposes the foot's
+        # horizontal velocity relative to the ground, so propulsion
+        # requires sweeping a loaded foot backward (the gait skill) and
+        # top speed is capped by sweep speed — symmetric action noise
+        # cannot rectify this into net motion.
+        combo_vel = jnp.stack(
+            [
+                joint_vel[0] + 0.5 * joint_vel[1] + 0.3 * pitch_dot,
+                joint_vel[3] + 0.5 * joint_vel[4] - 0.3 * pitch_dot,
+            ]
+        )
+        combo_ang = jnp.stack(
+            [
+                joints[0] + 0.5 * joints[1] + 0.3 * pitch,
+                joints[3] + 0.5 * joints[4] - 0.3 * pitch,
+            ]
+        )
+        foot_vx = vx + cls.z_rest * jnp.cos(combo_ang) * combo_vel
+        f_x = jnp.sum(
+            -cls.friction_mu * normal * jnp.tanh(foot_vx / cls.slip_v0)
+        )
+        acc_x = f_x / cls.mass
+        acc_z = -cls.gravity + jnp.sum(normal) / cls.mass
+        # Legs torque the torso; springs keep it near horizontal.
+        acc_pitch = (
+            0.08 * (cls.gear[0] * u[0] + cls.gear[3] * u[3])
+            - cls.pitch_k * pitch
+            - cls.pitch_d * pitch_dot
+        )
+
+        qvel = jnp.concatenate(
+            [jnp.stack([acc_x, acc_z, acc_pitch]), joint_acc]
+        ) * h + qvel
+        qvel = jnp.clip(qvel, -25.0, 25.0)  # hard stability guard
+        qpos = qpos + h * qvel  # semi-implicit Euler
+        return qpos, qvel
+
+    @classmethod
+    def reset(cls, key: jax.Array) -> EnvState:
+        k_pos, k_vel, k_next = jax.random.split(key, 3)
+        qpos = jnp.zeros(9).at[1].set(cls.z_rest).at[2:].add(
+            jax.random.uniform(k_pos, (7,), minval=-0.1, maxval=0.1)
+        )
+        qvel = 0.1 * jax.random.normal(k_vel, (9,))
+        return EnvState(
+            inner=(qpos, qvel),
+            obs=cls._obs(qpos, qvel),
+            step_count=jnp.int32(0),
+            episode_return=jnp.float32(0.0),
+            rng=k_next,
+        )
+
+    @classmethod
+    def step(cls, state: EnvState, action: jax.Array):
+        qpos, qvel = state.inner
+        u = jnp.clip(action, -cls.act_limit, cls.act_limit)
+        x_before = qpos[0]
+        h = cls.dt / cls.n_substeps
+
+        def sub(carry, _):
+            qp, qv = carry
+            return cls._substep(qp, qv, u, h), None
+
+        (qpos, qvel), _ = jax.lax.scan(
+            sub, (qpos, qvel), xs=None, length=cls.n_substeps
+        )
+        reward = (qpos[0] - x_before) / cls.dt - 0.1 * jnp.sum(u**2)
+
+        step_count = state.step_count + 1
+        ended = step_count >= cls.max_episode_steps  # truncation only
+
+        stepped = EnvState(
+            inner=(qpos, qvel),
+            obs=cls._obs(qpos, qvel),
+            step_count=step_count,
+            episode_return=state.episode_return + reward,
+            rng=state.rng,
+        )
+        fresh = cls.reset(state.rng)
+        next_state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(ended, a, b), fresh, stepped
+        )
+        out = StepOut(
+            next_obs=stepped.obs,
+            reward=reward,
+            terminated=jnp.float32(0.0),  # HalfCheetah never terminates
+            ended=ended,
+            final_return=stepped.episode_return,
+        )
+        return next_state, out
+
+
+ON_DEVICE_ENVS = {
+    "Pendulum-v1": PendulumJax,
+    "HalfCheetah-v3": CheetahRunJax,
+    "HalfCheetah-v4": CheetahRunJax,
+    "HalfCheetah-v5": CheetahRunJax,
+    "cheetah-run-jax": CheetahRunJax,
+}
 
 
 def get_on_device_env(name: str):

@@ -370,8 +370,14 @@ def attention(
     """
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
+        # TPU tiles are (8, 128) for f32: besides block divisibility,
+        # require sublane-aligned sequence lengths (T % 8 == 0) or the
+        # kernel would compile sublane-unaligned tiles that are only
+        # ever exercised in interpret mode.
         shapes_ok = (
-            q.shape[2] % min(block_q, q.shape[2]) == 0
+            q.shape[2] % 8 == 0
+            and k.shape[2] % 8 == 0
+            and q.shape[2] % min(block_q, q.shape[2]) == 0
             and k.shape[2] % min(block_k, k.shape[2]) == 0
         )
         impl = "pallas" if (on_tpu and shapes_ok) else "xla"

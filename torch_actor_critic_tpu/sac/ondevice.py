@@ -356,7 +356,8 @@ def train_on_device(
     )
     if start_epoch == 0:
         state, buffer, env_states, act_key, _ = loop.epoch(
-            state, buffer, env_states, act_key, steps=warmup_steps, warmup=True
+            state, buffer, env_states, act_key, steps=warmup_steps,
+            update_every=config.update_every, warmup=True,
         )
 
     import time
@@ -388,3 +389,56 @@ def train_on_device(
     if checkpointer is not None:
         checkpointer.wait()
     return metrics
+
+
+def benchmark_on_device(
+    env_name: str, steps: int = 500, n_envs: int = 16, update_every: int = 50
+) -> dict:
+    """Timed fused-loop epoch at the headline model config (hidden
+    [256,256], batch 64 — BASELINE.md); returns env/grad steps per sec
+    for ``bench.py``'s ``on_device`` section. Short names accepted
+    ("pendulum", "cheetah")."""
+    import time
+
+    from torch_actor_critic_tpu.envs.ondevice import get_on_device_env
+    from torch_actor_critic_tpu.models import Actor, DoubleCritic
+    from torch_actor_critic_tpu.utils.config import SACConfig
+
+    aliases = {"pendulum": "Pendulum-v1", "cheetah": "cheetah-run-jax"}
+    env_cls = get_on_device_env(aliases.get(env_name, env_name))
+    if env_cls is None:
+        raise ValueError(f"no on-device twin for {env_name!r}")
+    cfg = SACConfig(hidden_sizes=(256, 256), batch_size=64)
+    sac = SAC(
+        cfg,
+        Actor(
+            act_dim=env_cls.act_dim,
+            hidden_sizes=cfg.hidden_sizes,
+            act_limit=env_cls.act_limit,
+        ),
+        DoubleCritic(hidden_sizes=cfg.hidden_sizes),
+        env_cls.act_dim,
+    )
+    loop = OnDeviceLoop(sac, env_cls, n_envs=n_envs)
+    ts, buf, es, key = loop.init(jax.random.key(0), buffer_capacity=200_000)
+    ts, buf, es, key, _ = loop.epoch(
+        ts, buf, es, key, steps=update_every, update_every=update_every,
+        warmup=True,
+    )
+    # compile the measured epoch shape, then time a fresh dispatch
+    ts, buf, es, key, m = loop.epoch(
+        ts, buf, es, key, steps=steps, update_every=update_every
+    )
+    jax.block_until_ready(m["loss_q"])
+    t0 = time.perf_counter()
+    ts, buf, es, key, m = loop.epoch(
+        ts, buf, es, key, steps=steps, update_every=update_every
+    )
+    jax.block_until_ready(m["loss_q"])
+    dt = time.perf_counter() - t0
+    return {
+        "env": aliases.get(env_name, env_name),
+        "n_envs": n_envs,
+        "env_steps_per_sec": round(steps * n_envs / dt, 1),
+        "grad_steps_per_sec": round(steps / dt, 1),
+    }

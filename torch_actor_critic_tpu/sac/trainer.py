@@ -182,6 +182,13 @@ class Trainer:
         else:
             # Welford tracks per-feature stats of flat vectors; visual
             # and history observations run unnormalized.
+            if self.config.normalize_observations:
+                logger.warning(
+                    "normalize_observations=True ignored: obs spec %s is "
+                    "not a flat vector (visual/history stacks run "
+                    "unnormalized)",
+                    self.pool.obs_spec.shape,
+                )
             self.normalizer = IdentityNormalizer()
 
         actor_def, critic_def = build_models(self.config, self.pool)
