@@ -150,28 +150,82 @@ def test_pmean_actually_averages_across_devices():
     np.testing.assert_allclose(np.asarray(out), np.full(8, 3.5))
 
 
-def test_tp_sharding_specs():
-    """Megatron alternation: even-depth kernels column-sharded, odd
-    row-sharded, non-divisible dims replicated."""
-    from jax.sharding import PartitionSpec as P
-
+def _flat_specs(params, tp):
     from torch_actor_critic_tpu.parallel.sharding import tp_specs
+
+    specs = tp_specs(params, tp=tp)
+    return {
+        "/".join(str(getattr(p, "key", p)) for p in path): s
+        for path, s in jax.tree_util.tree_flatten_with_path(specs)[0]
+    }
+
+
+def test_tp_sharding_specs():
+    """Megatron alternation comes from explicit per-layer role
+    declarations: trunk layer 0 column-sharded, layer 1 row-sharded,
+    sibling heads (mu / log_std) get identical (replicated) specs."""
+    from jax.sharding import PartitionSpec as P
 
     actor = Actor(act_dim=ACT_DIM, hidden_sizes=(32, 32))
     params = actor.init(
         jax.random.key(0), jnp.zeros((OBS_DIM,)), jax.random.key(1)
     )
+    flat = _flat_specs(params, tp=2)
+    assert flat["params/MLP_0/Dense_0/col/kernel"] == P(None, "tp")
+    assert flat["params/MLP_0/Dense_0/col/bias"] == P("tp")
+    assert flat["params/MLP_0/Dense_1/row/kernel"] == P("tp", None)
+    assert flat["params/MLP_0/Dense_1/row/bias"] == P()
+    # The two heads are parallel siblings and MUST share a layout
+    # (round-1 weak #2: the old digit-sum heuristic gave them different
+    # ones). Both are declared replicate.
+    mu = {k: v for k, v in flat.items() if k.startswith("params/Dense_0")}
+    ls = {k: v for k, v in flat.items() if k.startswith("params/Dense_1")}
+    assert list(mu.values()) == list(ls.values()) == [P(), P()]
+
+
+def test_tp_sharding_specs_double_critic():
+    """Ensemble critic: leading num_qs axis never sharded; col/row
+    alternation on the trunk; final Dense(1) replicated (1 % tp != 0)."""
+    from jax.sharding import PartitionSpec as P
+
+    critic = DoubleCritic(hidden_sizes=(32, 32))
+    params = critic.init(
+        jax.random.key(0), jnp.zeros((OBS_DIM,)), jnp.zeros((ACT_DIM,))
+    )
+    flat = _flat_specs(params, tp=2)
+    ens = "params/ensemble/MLP_0"
+    assert flat[f"{ens}/Dense_0/col/kernel"] == P(None, None, "tp")
+    assert flat[f"{ens}/Dense_1/row/kernel"] == P(None, "tp", None)
+    # Final layer: declared col but width 1 is indivisible -> replicated.
+    assert flat[f"{ens}/Dense_2/col/kernel"] == P()
+
+
+def test_tp_collective_count_in_hlo():
+    """The compiled tp=2 actor-trunk forward carries exactly one
+    all-reduce — the single psum closing the Megatron col->row pair —
+    and no all-gathers (which would mean GSPMD fell back to gathering
+    activations instead of the intended pattern)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from torch_actor_critic_tpu.parallel.sharding import tp_specs
+
+    mesh = make_mesh(tp=2)
+    actor = Actor(act_dim=ACT_DIM, hidden_sizes=(32, 32))
+    obs = jnp.zeros((16, OBS_DIM))
+    params = actor.init(jax.random.key(0), obs, jax.random.key(1))
     specs = tp_specs(params, tp=2)
-    flat = {
-        "/".join(str(getattr(p, "key", p)) for p in path): s
-        for path, s in jax.tree_util.tree_flatten_with_path(specs)[0]
-    }
-    mlp = {k: v for k, v in flat.items() if "MLP_0" in k and "kernel" in k}
-    assert any(s == P(None, "tp") for s in mlp.values())  # column layers
-    assert any(s == P("tp", None) for s in mlp.values())  # row layers
-    # act_dim=2 heads: output dim divides tp=2 -> sharded or replicated,
-    # but never an invalid axis; every spec is a valid PartitionSpec.
-    assert all(isinstance(s, P) for s in flat.values())
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+    obs = jax.device_put(obs, NamedSharding(mesh, P()))
+
+    @jax.jit
+    def fwd(params, obs):
+        return actor.apply(params, obs, deterministic=True, with_logprob=False)
+
+    hlo = fwd.lower(sharded, obs).compile().as_text()
+    assert hlo.count("all-reduce(") + hlo.count("all-reduce-start(") == 1, hlo
+    assert "all-gather(" not in hlo and "all-gather-start(" not in hlo
 
 
 def test_dp_tp_hybrid_matches_dp_only():

@@ -31,8 +31,9 @@ ACT_LIMIT = 2.0
 
 
 def _dense_params(tree):
-    """(kernel, bias) of a wrapped Dense module subtree."""
-    inner = tree["Dense_0"]
+    """(kernel, bias) of a wrapped Dense module subtree (the single
+    inner nn.Dense is named by its TP role: Dense_0/col/row)."""
+    (inner,) = tree.values()
     return np.asarray(inner["kernel"]), np.asarray(inner["bias"])
 
 

@@ -93,6 +93,33 @@ def test_weights_only_restore(trained):
     assert int(tr2.buffer.size[0]) == 0  # untouched
 
 
+@pytest.mark.parametrize("dp", [1, 2])
+def test_warmup_counters_scale_with_envs(dp):
+    """PARITY.md §counters: `step` is the per-env lockstep counter (the
+    reference's per-rank step), so warmup data volume is
+    start_steps × n_envs and the first grad step happens after
+    update_after per-env steps at every dp."""
+    cfg = SACConfig(
+        hidden_sizes=(16, 16),
+        batch_size=16,
+        epochs=1,
+        steps_per_epoch=30,
+        start_steps=10,
+        update_after=10,
+        update_every=10,
+        buffer_size=1000,
+        max_ep_len=100,
+    )
+    tr = Trainer("Pendulum-v1", cfg, mesh=make_mesh(dp=dp))
+    tr.train()
+    # 30 lockstep steps x dp envs transitions total, dp per-device shards
+    np.testing.assert_array_equal(np.asarray(tr.buffer.size), [30] * dp)
+    # windows at step 20 and 30 ran bursts (step 10 <= update_after):
+    # 2 x update_every grad steps regardless of dp.
+    assert int(tr.state.step) == 20
+    tr.close()
+
+
 def test_train_cli_smoke(tmp_path):
     from torch_actor_critic_tpu.train import main
 

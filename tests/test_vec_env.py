@@ -141,6 +141,34 @@ def test_dead_worker_is_diagnosed(fake_factory):
         par.close()
 
 
+@needs_native
+def test_spawn_start_method_bootstrap():
+    """The production-default spawn path: workers bootstrap in a fresh
+    interpreter (no inherited monkeypatches/fds), resolve the env by
+    name, and match the sequential pool step-for-step. Round-1 weak #7:
+    only the fork path had ever run under test."""
+    n = 2
+    seq = SequentialEnvPool("Pendulum-v1", n, base_seed=5)
+    par = ParallelEnvPool(
+        "Pendulum-v1", n, base_seed=5, timeout_s=120, start_method="spawn"
+    )
+    try:
+        seeds = [5 + 10000 * i for i in range(n)]
+        np.testing.assert_allclose(
+            seq.reset_all(seeds), par.reset_all(seeds), rtol=1e-6
+        )
+        rng = np.random.default_rng(1)
+        for _ in range(5):
+            a = rng.uniform(-2, 2, (n, 1)).astype(np.float32)
+            os_, rs, ts, us = seq.step(a)
+            op_, rp, tp, up = par.step(a)
+            np.testing.assert_allclose(os_, op_, rtol=1e-6)
+            np.testing.assert_allclose(rs, rp, rtol=1e-6)
+    finally:
+        par.close()
+        seq.close()
+
+
 def test_make_env_pool_fallback(fake_factory):
     pool = make_env_pool("Fake-v0", 1, parallel=True)
     assert isinstance(pool, SequentialEnvPool)  # n==1 never forks workers

@@ -1,0 +1,56 @@
+"""Host env adapter contracts (envs/wrappers.py).
+
+Focused on the reproducibility surface: ``reset(seed)`` must actually
+reseed every env family (round-1 weak #5: dm_control envs silently
+ignored it — the trainer's per-env reset seeds were no-ops).
+"""
+
+import numpy as np
+import pytest
+
+from torch_actor_critic_tpu.envs.wrappers import make_env
+
+
+def test_gymnasium_reset_seed_deterministic():
+    env = make_env("Pendulum-v1", seed=0)
+    a = env.reset(seed=123)
+    b = env.reset(seed=123)
+    c = env.reset(seed=124)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    env.close()
+
+
+def test_dm_control_reset_seed_deterministic():
+    pytest.importorskip("dm_control")
+    env = make_env("dm:cartpole:swingup", seed=0)
+    a = env.reset(seed=123)
+    b = env.reset(seed=123)
+    c = env.reset(seed=124)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    # sample_action is reseeded too (warmup reproducibility).
+    env.reset(seed=5)
+    s1 = env.sample_action()
+    env.reset(seed=5)
+    s2 = env.sample_action()
+    np.testing.assert_array_equal(s1, s2)
+
+
+def test_dm_control_reset_without_seed_keeps_stream():
+    """No seed -> episodes keep drawing from the existing stream (two
+    consecutive unseeded resets of a stochastic-init task differ)."""
+    pytest.importorskip("dm_control")
+    env = make_env("dm:cartpole:swingup", seed=7)
+    a = env.reset()
+    b = env.reset()
+    assert not np.array_equal(a, b)
+
+
+def test_history_env_propagates_reset_seed():
+    env = make_env("Pendulum-v1|history:4", seed=0)
+    a = env.reset(seed=9)
+    b = env.reset(seed=9)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape[0] == 4
+    env.close()

@@ -29,10 +29,12 @@ directly comparable to the host-env path on the same task.
 
 from __future__ import annotations
 
+import logging
 import typing as t
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 
@@ -173,10 +175,15 @@ class CheetahRunJax:
     # back>front ordering but the ankles are strengthened so the
     # swing-lift DoF stays controllable (deliberate deviation — these
     # are surrogate dynamics).
-    gear = jnp.array([130.0, 100.0, 90.0, 130.0, 100.0, 70.0])
-    joint_k = jnp.array([100.0, 100.0, 100.0, 100.0, 100.0, 100.0])
-    joint_d = jnp.array([12.0, 12.0, 12.0, 12.0, 12.0, 12.0])
-    joint_range = jnp.array([1.05, 1.1, 0.8, 1.0, 1.2, 0.9])
+    # numpy, NOT jnp: class attributes evaluate at import time, and a
+    # module-level jnp.array would eagerly initialize the JAX backend
+    # for anyone importing the envs package (host-side env workers must
+    # stay off the accelerator). They become on-device constants when
+    # traced into the jitted step.
+    gear = np.array([130.0, 100.0, 90.0, 130.0, 100.0, 70.0], np.float32)
+    joint_k = np.array([100.0] * 6, np.float32)
+    joint_d = np.array([12.0] * 6, np.float32)
+    joint_range = np.array([1.05, 1.1, 0.8, 1.0, 1.2, 0.9], np.float32)
 
     z_rest = 0.6  # standing torso height
     ground_k = 4000.0
@@ -337,8 +344,28 @@ ON_DEVICE_ENVS = {
     "cheetah-run-jax": CheetahRunJax,
 }
 
+# On-device twins whose *dynamics* are a surrogate, not physics-parity
+# with the env name they answer to (see CheetahRunJax docstring).
+_SURROGATE_DYNAMICS = {"HalfCheetah-v3", "HalfCheetah-v4", "HalfCheetah-v5"}
+
 
 def get_on_device_env(name: str):
     """Registry lookup; None when the task has no pure-JAX twin (host
-    envs remain the general path)."""
-    return ON_DEVICE_ENVS.get(name)
+    envs remain the general path).
+
+    Resolving a real gym ID to a surrogate-dynamics twin logs a warning:
+    throughput/scaling numbers transfer, return values do NOT — anyone
+    comparing returns against a MuJoCo run must see the substitution.
+    """
+    env = ON_DEVICE_ENVS.get(name)
+    if env is not None and name in _SURROGATE_DYNAMICS:
+        logging.getLogger(__name__).warning(
+            "on-device env for %r uses SURROGATE dynamics (%s): throughput "
+            "comparisons are valid, return values are NOT comparable to "
+            "MuJoCo %s. Use the host-loop path (on_device=False) for "
+            "physics-parity returns.",
+            name,
+            env.__name__,
+            name,
+        )
+    return env

@@ -269,6 +269,18 @@ def _worker_main(
     parent_pid: int,
 ):
     """Env worker: build env, handshake spec, then serve futex commands."""
+    # Workers are pure host-side env steppers. Force the CPU backend
+    # BEFORE anything touches jax's lazy backend init: under spawn the
+    # fresh interpreter's sitecustomize may re-register an accelerator
+    # platform, and a worker trying to grab the TPU the parent already
+    # holds blocks the whole handshake.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover - jax config is best-effort here
+        pass
     from torch_actor_critic_tpu.native import load_runtime
 
     shm = None
@@ -323,24 +335,45 @@ class ParallelEnvPool:
         # once at startup, in parallel across workers.
         ctx = mp.get_context(start_method)
         self._conns, self._procs = [], []
-        for i in range(n):
-            parent_conn, child_conn = ctx.Pipe()
-            p = ctx.Process(
-                target=_worker_main,
-                args=(
-                    i,
-                    env_name,
-                    base_seed + seed_stride * i,
-                    child_conn,
-                    os.getpid(),
-                ),
-                daemon=True,
-                name=f"tac-env-{i}",
-            )
-            p.start()
-            child_conn.close()
-            self._conns.append(parent_conn)
-            self._procs.append(p)
+        # Spawned children boot a fresh interpreter that does NOT inherit
+        # the parent's sys.path — when this package is imported from a
+        # source checkout (not site-packages), workers would die with
+        # ModuleNotFoundError while unpickling the worker target. Export
+        # the package root via PYTHONPATH for the duration of the spawns
+        # (os.environ is snapshotted by each child at start()).
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        overrides = {
+            "PYTHONPATH": pkg_root
+            + (
+                os.pathsep + os.environ["PYTHONPATH"]
+                if os.environ.get("PYTHONPATH")
+                else ""
+            ),
+            # Workers are pure host-side env steppers and must never
+            # bind the accelerator the parent holds (or trip over an
+            # accelerator platform the fresh interpreter cannot
+            # register): force the CPU backend in the env snapshot the
+            # children inherit.
+            "JAX_PLATFORMS": "cpu",
+            # Some accelerator images install a sitecustomize hook that
+            # initializes the accelerator client at *interpreter start*
+            # when this variable is set — before any in-process override
+            # can run — and a worker doing so deadlocks against the
+            # parent's exclusive chip grant. Blank it for workers.
+            "PALLAS_AXON_POOL_IPS": "",
+        }
+        saved = {k: os.environ.get(k) for k in overrides}
+        os.environ.update(overrides)
+        try:
+            self._spawn_workers(ctx, n, env_name, base_seed, seed_stride)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
 
         try:
             specs = [self._recv(i, "spec") for i in range(n)]
@@ -383,6 +416,26 @@ class ParallelEnvPool:
         self._finalizer = atexit.register(self.close)
 
     # ------------------------------------------------------------ plumbing
+
+    def _spawn_workers(self, ctx, n, env_name, base_seed, seed_stride):
+        for i in range(n):
+            parent_conn, child_conn = ctx.Pipe()
+            p = ctx.Process(
+                target=_worker_main,
+                args=(
+                    i,
+                    env_name,
+                    base_seed + seed_stride * i,
+                    child_conn,
+                    os.getpid(),
+                ),
+                daemon=True,
+                name=f"tac-env-{i}",
+            )
+            p.start()
+            child_conn.close()
+            self._conns.append(parent_conn)
+            self._procs.append(p)
 
     def _recv(self, i: int, expect: str):
         if not self._conns[i].poll(self.timeout_ms / 1000):

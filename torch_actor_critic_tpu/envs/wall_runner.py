@@ -80,6 +80,11 @@ class DeepMindWallRunner:
         return MultiObservation(features=features, frame=frame)
 
     def reset(self, seed: int | None = None) -> MultiObservation:
+        if seed is not None:
+            from torch_actor_critic_tpu.envs.wrappers import reseed_dm_env
+
+            reseed_dm_env(self.env, seed)
+            self._rng = np.random.default_rng(seed)
         ts = self.env.reset()
         return self._process(ts.observation)
 

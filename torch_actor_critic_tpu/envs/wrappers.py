@@ -62,6 +62,26 @@ class GymnasiumEnv:
         self.env.close()
 
 
+def reseed_dm_env(env, seed: int | None) -> None:
+    """Reseed a dm_control environment in place (suite or composer).
+
+    dm_control has no ``reset(seed)`` API — randomness comes from a
+    ``RandomState`` held by the task (suite envs) or the environment
+    (composer envs); replacing it is the documented way to reseed.
+    Round-1 weak #5: ``reset`` previously ignored its seed argument
+    entirely, so the trainer's per-env reset seeds were no-ops for dm
+    envs.
+    """
+    if seed is None:
+        return
+    rs = np.random.RandomState(seed)
+    task = getattr(env, "task", None)
+    if task is not None and hasattr(task, "_random"):
+        task._random = rs  # suite control.Environment
+    elif hasattr(env, "_random_state"):
+        env._random_state = rs  # composer.Environment
+
+
 class DmControlEnv:
     """Generic dm_control suite task with flattened observations.
 
@@ -92,6 +112,9 @@ class DmControlEnv:
         )
 
     def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            reseed_dm_env(self.env, seed)
+            self._rng = np.random.default_rng(seed)
         ts = self.env.reset()
         return self._flatten(ts.observation)
 

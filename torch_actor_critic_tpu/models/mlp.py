@@ -44,17 +44,29 @@ def torch_linear_bias_init(fan_in: int):
 
 
 class Dense(nn.Module):
-    """``nn.Dense`` with torch-default initialization."""
+    """``nn.Dense`` with torch-default initialization.
+
+    ``tp_role`` is the layer's declared tensor-parallel layout —
+    ``'col'`` (shard the output features), ``'row'`` (shard the input
+    features), or ``'replicate'``. The role is encoded as the inner
+    parameter-subtree name, so sharding-spec derivation
+    (:func:`torch_actor_critic_tpu.parallel.sharding.tp_spec`) reads an
+    explicit declaration made *by the module that knows its position*
+    instead of guessing from auto-generated names.
+    """
 
     features: int
+    tp_role: str = "replicate"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         fan_in = x.shape[-1]
+        name = self.tp_role if self.tp_role in ("col", "row") else "Dense_0"
         return nn.Dense(
             self.features,
             kernel_init=torch_linear_kernel_init,
             bias_init=torch_linear_bias_init(fan_in),
+            name=name,
         )(x)
 
 
@@ -64,6 +76,10 @@ class MLP(nn.Module):
     ``hidden_sizes`` are the layer widths; ReLU after every layer when
     ``activate_final`` (the actor trunk, ref ``networks/linear.py:33-35``),
     or after all but the last (the critic, ref ``networks/linear.py:63-67``).
+
+    Layers declare Megatron-paired tensor-parallel roles by their own
+    index — even layers column-parallel, odd row-parallel — so a
+    consecutive (col, row) pair costs a single ``psum`` under ``tp``.
     """
 
     hidden_sizes: t.Sequence[int]
@@ -73,7 +89,7 @@ class MLP(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         n = len(self.hidden_sizes)
         for i, width in enumerate(self.hidden_sizes):
-            x = Dense(width)(x)
+            x = Dense(width, tp_role="col" if i % 2 == 0 else "row")(x)
             if self.activate_final or i < n - 1:
                 x = nn.relu(x)
         return x

@@ -70,12 +70,15 @@ class MultiHeadAttention(nn.Module):
         def split(y):  # (B, T, D) -> (B, H, T, d)
             return y.reshape(b, s, self.num_heads, head_dim).transpose(0, 2, 1, 3)
 
-        q = split(Dense(d_model)(x))
-        k = split(Dense(d_model)(x))
-        v = split(Dense(d_model)(x))
+        # Megatron attention pairing: q/k/v projections column-parallel
+        # (equivalently: heads sharded over tp), output projection
+        # row-parallel — one psum per attention block under tp.
+        q = split(Dense(d_model, tp_role="col")(x))
+        k = split(Dense(d_model, tp_role="col")(x))
+        v = split(Dense(d_model, tp_role="col")(x))
         out = self.attention_fn(q, k, v, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, d_model)
-        return Dense(d_model)(out)
+        return Dense(d_model, tp_role="row")(out)
 
 
 class TransformerBlock(nn.Module):
@@ -92,9 +95,9 @@ class TransformerBlock(nn.Module):
             nn.LayerNorm()(x)
         )
         h = nn.LayerNorm()(x)
-        h = Dense(self.mlp_ratio * d_model)(h)
+        h = Dense(self.mlp_ratio * d_model, tp_role="col")(h)
         h = nn.gelu(h)
-        h = Dense(d_model)(h)
+        h = Dense(d_model, tp_role="row")(h)
         return x + h
 
 

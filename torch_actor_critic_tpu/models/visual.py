@@ -110,8 +110,10 @@ class SimpleCNN(nn.Module):
                 )
             x = nn.relu(x)
         x = x.reshape(x.shape[:-3] + (-1,))
-        x = Dense(self.dense_size)(x)
-        x = Dense(self.out_features)(x)
+        # Megatron pair over tp: the wide flatten->dense is
+        # column-parallel, the projection to out_features row-parallel.
+        x = Dense(self.dense_size, tp_role="col")(x)
+        x = Dense(self.out_features, tp_role="row")(x)
         return x
 
 

@@ -20,9 +20,10 @@ Three implementations of the same math, one contract:
   one ``(block, head_dim)`` tile of each operand (long sequences
   stream from HBM through the BlockSpec pipeline), MXU matmuls with
   f32 accumulators in VMEM scratch. Wrapped in a ``custom_vjp`` whose
-  backward recomputes through :func:`blockwise_attention`, so the fast
-  forward is still fully differentiable. Head dims are zero-padded to
-  the 128-lane width transparently.
+  backward is *also* Pallas (FlashAttention-2 style: forward saves the
+  per-row logsumexp; dQ and dK/dV kernels recompute probability tiles
+  from it), so training gets the kernel in both directions. Head dims
+  are zero-padded to the 128-lane width transparently.
 
 All take ``(batch, heads, seq, head_dim)`` arrays. ``q_offset`` /
 ``k_offset`` are *global* position offsets of the local q/k chunks —
@@ -183,8 +184,9 @@ _LANE = 128  # TPU lane width: last tile dim, and scratch column count
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-    *, block_q: int, block_k: int, scale: float, causal: bool,
+    q_ref, k_ref, v_ref, o_ref, *rest,
+    block_q: int, block_k: int, scale: float, causal: bool,
+    save_lse: bool = False,
 ):
     """One ``(batch·head, q-block, k-block)`` program.
 
@@ -195,6 +197,12 @@ def _flash_kernel(
     as :func:`online_block_update`.
     """
     from jax.experimental import pallas as pl  # deferred: TPU-only path
+
+    if save_lse:
+        lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        lse_ref = None
+        m_ref, l_ref, acc_ref = rest
 
     iq = pl.program_id(1)
     j = pl.program_id(2)
@@ -246,6 +254,36 @@ def _flash_kernel(
         o_ref[0] = (
             acc_ref[:] / jnp.where(l == 0.0, 1.0, l)[:, None]
         ).astype(o_ref.dtype)
+        if save_lse:
+            # Per-row logsumexp — the only forward residual the flash
+            # backward needs besides (q, k, v, o). All-masked rows keep
+            # lse = -inf, which the backward maps to zero probability.
+            lse_ref[0] = jnp.where(
+                l == 0.0, NEG_INF, m_ref[:, 0] + jnp.log(jnp.where(l == 0.0, 1.0, l))
+            )
+
+
+def _pad_head_dim(*arrays: jax.Array) -> t.Tuple[jax.Array, ...]:
+    """Zero-pad the trailing (head) axis to the 128-lane width."""
+    d = arrays[0].shape[-1]
+    if d % _LANE == 0:
+        return arrays
+    pad = _LANE - d % _LANE
+    return tuple(
+        jnp.pad(x, ((0, 0),) * (x.ndim - 1) + ((0, pad),)) for x in arrays
+    )
+
+
+def _check_blocks(tq: int, tk: int, block_q: int, block_k: int):
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(
+            f"flash_attention: Tq={tq} must divide by block_q={block_q} and "
+            f"Tk={tk} by block_k={block_k}; use attention(impl='xla') or "
+            "blockwise_attention for ragged lengths."
+        )
+    return block_q, block_k
 
 
 def _flash_forward(
@@ -256,39 +294,41 @@ def _flash_forward(
     block_q: int,
     block_k: int,
     interpret: bool,
-) -> jax.Array:
+    save_lse: bool = False,
+):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
-    if tq % block_q or tk % block_k:
-        raise ValueError(
-            f"flash_attention: Tq={tq} must divide by block_q={block_q} and "
-            f"Tk={tk} by block_k={block_k}; use attention(impl='xla') or "
-            "blockwise_attention for ragged lengths."
-        )
+    block_q, block_k = _check_blocks(tq, tk, block_q, block_k)
     # The softmax scale uses the *logical* head dim; zero-pad the head
     # axis to the lane width (dot products are unchanged by zero columns,
     # padded output columns are sliced away).
     scale = 1.0 / math.sqrt(d)
-    if d % _LANE:
-        pad = _LANE - d % _LANE
-        q, k, v = (
-            jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, pad))) for x in (q, k, v)
-        )
+    q, k, v = _pad_head_dim(q, k, v)
     dp = q.shape[-1]
     qr = q.reshape(b * h, tq, dp)
     kr = k.reshape(b * h, tk, dp)
     vr = v.reshape(b * h, tk, dp)
-    out = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((b * h, tq, dp), q.dtype)]
+    out_specs = [
+        pl.BlockSpec((1, block_q, dp), lambda bh, iq, j: (bh, iq, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    if save_lse:
+        out_shape.append(jax.ShapeDtypeStruct((b * h, tq), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, block_q), lambda bh, iq, j: (bh, iq),
+                         memory_space=pltpu.VMEM)
+        )
+    outs = pl.pallas_call(
         functools.partial(
             _flash_kernel,
             block_q=block_q, block_k=block_k, scale=scale, causal=causal,
+            save_lse=save_lse,
         ),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, dp), q.dtype),
+        out_shape=out_shape,
         grid=(b * h, tq // block_q, tk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, dp), lambda bh, iq, j: (bh, iq, 0),
@@ -298,8 +338,7 @@ def _flash_forward(
             pl.BlockSpec((1, block_k, dp), lambda bh, iq, j: (bh, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dp), lambda bh, iq, j: (bh, iq, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANE), jnp.float32),  # m (col 0)
             pltpu.VMEM((block_q, _LANE), jnp.float32),  # l (col 0)
@@ -307,7 +346,208 @@ def _flash_forward(
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, tq, dp)[..., :d]
+    out = outs[0].reshape(b, h, tq, dp)[..., :d]
+    if save_lse:
+        return out, outs[1].reshape(b, h, tq)
+    return out
+
+
+def _attn_probs(q, k, lse, scale, causal, iq, jk, block_q, block_k):
+    """Recompute the (block_q, block_k) probability tile from saved lse.
+
+    ``p[r, c] = exp(s[r, c] - lse[r])`` — exactly the forward's softmax
+    weights, recovered without re-running the online max/normalizer scan.
+    Shared by both backward kernels.
+    """
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    safe_lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
+    p = jnp.exp(s - safe_lse[:, None])
+    return jnp.where(jnp.isneginf(s), 0.0, p)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, block_q: int, block_k: int, scale: float, causal: bool,
+):
+    """dQ: grid ``(batch·head, q-block, k-block)``, k innermost.
+
+    ``ds = p · (dO Vᵀ − Δ)``, ``dq += ds K · scale`` accumulated in VMEM
+    scratch over the k sweep, written once on the final k step. Δ is the
+    precomputed ``rowsum(dO ∘ O)`` (standard FlashAttention-2 backward).
+    """
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    j = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    needed = True if not causal else j * block_k <= (iq + 1) * block_q - 1
+
+    @pl.when(needed)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p = _attn_probs(
+            q, k_blk, lse_ref[0], scale, causal, iq, j, block_q, block_k
+        )
+        dpv = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dpv - delta_ref[0][:, None])
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(j == n_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, block_q: int, block_k: int, scale: float, causal: bool,
+):
+    """dK/dV: grid ``(batch·head, k-block, q-block)``, q innermost.
+
+    ``dv += pᵀ dO``; ``dk += dsᵀ Q · scale`` — both accumulated in VMEM
+    scratch over the q sweep for a fixed k block.
+    """
+    from jax.experimental import pallas as pl
+
+    jk = pl.program_id(1)
+    i = pl.program_id(2)
+    n_qb = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # Under causality, q blocks strictly before this k block's start see
+    # none of it; skip them.
+    needed = True if not causal else (i + 1) * block_q - 1 >= jk * block_k
+
+    @pl.when(needed)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p = _attn_probs(
+            q, k_blk, lse_ref[0], scale, causal, i, jk, block_q, block_k
+        )
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dpv = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dpv - delta_ref[0][:, None])
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(i == n_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, o, lse, g, causal, block_q, block_k, interpret,
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q, block_k = _check_blocks(tq, tk, block_q, block_k)
+    scale = 1.0 / math.sqrt(d)
+    # Δ = rowsum(dO ∘ O): cheap elementwise reduce, fused by XLA; padded
+    # head columns of o/g are zero so padding doesn't perturb it.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).reshape(b * h, tq)
+    q, k, v, g = _pad_head_dim(q, k, v, g)
+    dp = q.shape[-1]
+    qr = q.reshape(b * h, tq, dp)
+    kr = k.reshape(b * h, tk, dp)
+    vr = v.reshape(b * h, tk, dp)
+    gr = g.reshape(b * h, tq, dp)
+    lse_r = lse.reshape(b * h, tq)
+
+    qspec = pl.BlockSpec((1, block_q, dp), lambda bh, x, y: (bh, x, 0),
+                         memory_space=pltpu.VMEM)
+    kspec_dq = pl.BlockSpec((1, block_k, dp), lambda bh, iq, j: (bh, j, 0),
+                            memory_space=pltpu.VMEM)
+    rowspec = pl.BlockSpec((1, block_q), lambda bh, x, y: (bh, x),
+                           memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel,
+            block_q=block_q, block_k=block_k, scale=scale, causal=causal,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, dp), q.dtype),
+        grid=(b * h, tq // block_q, tk // block_k),
+        in_specs=[qspec, kspec_dq, kspec_dq, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse_r, delta)
+
+    # dK/dV sweep: the grid's second axis is the k block, q innermost.
+    qspec_kv = pl.BlockSpec((1, block_q, dp), lambda bh, jk, i: (bh, i, 0),
+                            memory_space=pltpu.VMEM)
+    kspec_kv = pl.BlockSpec((1, block_k, dp), lambda bh, jk, i: (bh, jk, 0),
+                            memory_space=pltpu.VMEM)
+    rowspec_kv = pl.BlockSpec((1, block_q), lambda bh, jk, i: (bh, i),
+                              memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel,
+            block_q=block_q, block_k=block_k, scale=scale, causal=causal,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, dp), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, dp), v.dtype),
+        ],
+        grid=(b * h, tk // block_k, tq // block_q),
+        in_specs=[qspec_kv, kspec_kv, kspec_kv, qspec_kv, rowspec_kv,
+                  rowspec_kv],
+        out_specs=[kspec_kv, kspec_kv],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dp), jnp.float32),
+            pltpu.VMEM((block_k, dp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse_r, delta)
+
+    dq = dq.reshape(b * h, tq, dp)[..., :d].reshape(b, h, tq, d)
+    dk = dk.reshape(b * h, tk, dp)[..., :d].reshape(b, h, tk, d)
+    dv = dv.reshape(b * h, tk, dp)[..., :d].reshape(b, h, tk, d)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -320,28 +560,35 @@ def flash_attention(
     block_k: int = 128,
     interpret: bool = False,
 ):
-    """Pallas TPU flash attention (forward); backward recomputes via
-    :func:`blockwise_attention`'s VJP, so gradients are exact.
+    """Pallas TPU flash attention, forward *and* backward kernels.
+
+    The forward is the online-softmax streaming kernel; under
+    ``jax.grad`` it additionally saves the per-row logsumexp, and the
+    backward runs two Pallas kernels (dQ over k-blocks; dK/dV over
+    q-blocks) that recompute probability tiles from the saved lse — the
+    FlashAttention-2 scheme, O(block²) VMEM, no (Tq, Tk) matrix ever
+    materialized in either direction.
 
     Requires ``Tq % block_q == 0`` and ``Tk % block_k == 0`` (raises
     ``ValueError`` otherwise); any head dim works (zero-padded to the
-    128-lane width internally). ``interpret=True`` runs the kernel in
+    128-lane width internally). ``interpret=True`` runs the kernels in
     the Pallas interpreter (CPU-testable; used by the test suite).
     """
     return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    out, lse = _flash_forward(
+        q, k, v, causal, block_q, block_k, interpret, save_lse=True
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: blockwise_attention(q, k, v, causal, block_k=block_k),
-        q, k, v,
+    q, k, v, o, lse = res
+    return _flash_backward(
+        q, k, v, o, lse, g, causal, block_q, block_k, interpret
     )
-    return vjp(g)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -71,6 +71,57 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
+def global_statistics(
+    values: t.Sequence[float], with_min_max: bool = True
+) -> t.Dict[str, float]:
+    """Global mean/std/min/max of per-process scalar collections.
+
+    The TPU-native replacement for both the reference's
+    ``mpi_statistics_scalar`` (ref ``sac/mpi.py:101-115``) and its
+    per-step point-to-point episode-stat exchange (ref
+    ``sac/algorithm.py:262-271``): every process contributes a
+    fixed-size summary ``[n, sum, sumsq, min, max]`` which is
+    all-gathered across hosts ONCE per call — run it at epoch
+    boundaries, off the hot loop, instead of blocking every env step
+    the way the reference does. Single-process runs never touch the
+    collective path.
+    """
+    import numpy as np
+
+    x = np.asarray(list(values), np.float64)
+    local = np.array(
+        [
+            x.size,
+            x.sum() if x.size else 0.0,
+            (x**2).sum() if x.size else 0.0,
+            x.min() if x.size else np.inf,
+            x.max() if x.size else -np.inf,
+        ]
+    )
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        # (num_processes, 5); a host-level DCN gather, not device code.
+        all_local = np.asarray(multihost_utils.process_allgather(local))
+        local = np.array(
+            [
+                all_local[:, 0].sum(),
+                all_local[:, 1].sum(),
+                all_local[:, 2].sum(),
+                all_local[:, 3].min(),
+                all_local[:, 4].max(),
+            ]
+        )
+    n, s, ss, mn, mx = local
+    mean = s / n if n else 0.0
+    var = max(ss / n - mean**2, 0.0) if n else 0.0
+    stats = {"n": float(n), "mean": float(mean), "std": float(var**0.5)}
+    if with_min_max:
+        stats["min"] = float(mn) if n else 0.0
+        stats["max"] = float(mx) if n else 0.0
+    return stats
+
+
 def process_info() -> t.Tuple[int, int]:
     """(process_index, process_count) — ref ``proc_id``/``num_procs``
     (``sac/mpi.py:37-43``)."""

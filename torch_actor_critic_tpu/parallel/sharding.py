@@ -19,50 +19,51 @@ partitions every matmul of the fused SAC step.
 
 from __future__ import annotations
 
-import re
 import typing as t
 
 import jax
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-_INT = re.compile(r"_(\d+)$")
 
+def _tp_role(path: t.Tuple) -> str:
+    """The layer's declared TP role, read off the parameter path.
 
-def _path_depth(path: t.Tuple) -> int:
-    """Sum of the trailing integers of module names along a param path
-    (``MLP_0/Dense_3/Dense_0 -> 3``). Consecutive layers of one trunk
-    differ by one, which is exactly the parity the Megatron
-    column/row alternation needs."""
-    depth = 0
+    Every :class:`~torch_actor_critic_tpu.models.mlp.Dense` names its
+    inner ``nn.Dense`` subtree after the role its parent module declared
+    (``col`` / ``row``; anything else means replicate) — e.g.
+    ``MLP_0/Dense_1/row/kernel``. This is an explicit per-layer
+    declaration plumbed from the modules, not a heuristic over
+    auto-generated names: sibling heads (``mu`` / ``log_std``) share a
+    role by construction.
+    """
     for entry in path:
-        name = getattr(entry, "key", None) or getattr(entry, "name", "")
-        m = _INT.search(str(name))
-        if m:
-            depth += int(m.group(1))
-    return depth
+        name = str(getattr(entry, "key", getattr(entry, "name", entry)))
+        if name in ("col", "row"):
+            return name
+    return "replicate"
 
 
 def tp_spec(path: t.Tuple, leaf: jax.Array, tp: int) -> P:
     """PartitionSpec for one parameter leaf.
 
-    Kernels ``(..., in, out)``: even path-depth shards ``out``
-    (column-parallel), odd shards ``in`` (row-parallel) — whichever is
+    Kernels ``(..., in, out)``: a ``col`` layer shards ``out``
+    (column-parallel), a ``row`` layer shards ``in`` — whichever is
     chosen must divide by ``tp``, else the leaf stays replicated.
     Biases follow their layer's activation sharding (sharded only for
     column-parallel layers). Leading axes (e.g. the critic-ensemble
     ``num_qs`` axis) are never sharded.
     """
     name = str(getattr(path[-1], "key", path[-1]) if path else "")
-    even = _path_depth(path) % 2 == 0
+    role = _tp_role(path)
     shape = leaf.shape
     if name == "kernel" and leaf.ndim >= 2:
-        if even and shape[-1] % tp == 0:
+        if role == "col" and shape[-1] % tp == 0:
             return P(*([None] * (leaf.ndim - 1)), "tp")
-        if not even and shape[-2] % tp == 0:
+        if role == "row" and shape[-2] % tp == 0:
             return P(*([None] * (leaf.ndim - 2)), "tp", None)
         return P()
-    if name == "bias" and leaf.ndim >= 1 and even and shape[-1] % tp == 0:
+    if name == "bias" and leaf.ndim >= 1 and role == "col" and shape[-1] % tp == 0:
         return P(*([None] * (leaf.ndim - 1)), "tp")
     return P()
 

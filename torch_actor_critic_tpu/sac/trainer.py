@@ -45,7 +45,7 @@ from torch_actor_critic_tpu.parallel import (
     make_mesh,
     shard_chunk,
 )
-from torch_actor_critic_tpu.parallel.distributed import is_coordinator
+from torch_actor_critic_tpu.parallel.distributed import global_statistics, is_coordinator
 from torch_actor_critic_tpu.sac.algorithm import SAC
 from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
 from torch_actor_critic_tpu.utils.config import SACConfig
@@ -111,18 +111,6 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
         )
         critic = DoubleCritic(hidden_sizes=config.hidden_sizes, num_qs=config.num_qs)
     return actor, critic
-
-
-def _stack_obs(obs_list: t.Sequence) -> t.Any:
-    """Stack a list of observation pytrees along a new leading axis."""
-    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *obs_list)
-
-
-def _row(tree: t.Any, i: int) -> t.Any:
-    """Copy of row ``i`` of a stacked observation pytree. A copy, not a
-    view: staged transitions must survive in-place writes to the stacked
-    array (episode resets overwrite rows)."""
-    return jax.tree_util.tree_map(lambda x: np.array(x[i]), tree)
 
 
 def _set_row(tree: t.Any, i: int, value: t.Any) -> None:
@@ -295,14 +283,14 @@ class Trainer:
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
     def _build_chunk(self, staging) -> Batch:
-        """staging[i] is a list of per-env transition tuples; result has
-        leading axes (n_envs, window)."""
+        """``staging`` is a list (one entry per lockstep step) of batched
+        transition tuples with leading axis ``n_envs``; the chunk stacks
+        them to leading axes ``(n_envs, window)``."""
 
         def stack_field(idx):
-            per_env = [
-                _stack_obs([tr[idx] for tr in env_stage]) for env_stage in staging
-            ]
-            return _stack_obs(per_env)
+            return jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs, axis=1), *[tr[idx] for tr in staging]
+            )
 
         return Batch(
             states=stack_field(0),
@@ -324,9 +312,16 @@ class Trainer:
         )
         ep_ret = np.zeros(n)
         ep_len = np.zeros(n, np.int64)
-        staging: t.List[list] = [[] for _ in range(n)]
+        staging: t.List[tuple] = []
 
-        step = 0  # lockstep iteration count (the reference's per-rank `step`)
+        # `step` counts LOCKSTEP iterations: every env (= every dp slice)
+        # has taken `step` steps — identical to the reference's per-rank
+        # counter (each MPI rank steps its one env, ref :226). Thus
+        # start_steps/update_after are per-env thresholds and total data
+        # volume scales with dp exactly as the reference's scales with
+        # worker count (1000 warmup steps × N ranks there, × n_envs
+        # here). Documented in PARITY.md §counters.
+        step = 0
         last_metrics: dict = {}
         episode_rewards: list = []
         episode_lengths: list = []
@@ -356,32 +351,41 @@ class Trainer:
                     actions = self._policy_actions(obs)
 
                 # --- env step (one lockstep pool dispatch) + bookkeeping
-                # (ref :238-260) ---
+                # (ref :238-260), batch numpy ops across envs — no
+                # per-env Python in the common path ---
                 epoch_ended = t_ == cfg.steps_per_epoch - 1
                 next_obs, rewards, terms, truncs = self.pool.step(actions)
                 next_obs = self._normalize(next_obs, update=True)
+                terms = np.asarray(terms, bool)
+                truncs = np.asarray(truncs, bool)
+                rewards = np.asarray(rewards, np.float32)
                 ep_len += 1
                 ep_ret += rewards
-                for i in range(n):
-                    # max_ep_len bypass (ref :241): an episode cut by the
-                    # length cap is a truncation — do not zero the
-                    # bootstrap.
-                    hit_cap = ep_len[i] >= cfg.max_ep_len
-                    done_for_buffer = float(terms[i] and not hit_cap)
-                    staging[i].append(
-                        (
-                            _row(obs, i),
-                            actions[i],
-                            rewards[i],
-                            _row(next_obs, i),
-                            done_for_buffer,
-                        )
+                # max_ep_len bypass (ref :241): an episode cut by the
+                # length cap is a truncation — do not zero the bootstrap.
+                hit_cap = ep_len >= cfg.max_ep_len
+                done_for_buffer = (terms & ~hit_cap).astype(np.float32)
+                # Stage whole batched pytrees. next_obs is copied because
+                # episode resets overwrite its rows in place below; obs
+                # is never mutated after this point.
+                staging.append(
+                    (
+                        obs,
+                        actions,
+                        rewards,
+                        jax.tree_util.tree_map(np.array, next_obs),
+                        done_for_buffer,
                     )
+                )
 
-                    if render and i == 0 and is_coordinator():
-                        self.pool.render_at(0)
+                if render and is_coordinator():
+                    self.pool.render_at(0)
 
-                    if terms[i] or truncs[i] or hit_cap or epoch_ended:
+                ended = terms | truncs | hit_cap
+                if epoch_ended:
+                    ended = np.ones_like(ended)
+                if ended.any():
+                    for i in map(int, np.flatnonzero(ended)):
                         episode_rewards.append(float(ep_ret[i]))
                         episode_lengths.append(int(ep_len[i]))
                         _set_row(
@@ -389,8 +393,8 @@ class Trainer:
                             i,
                             self._normalize(self.pool.reset_at(i), update=True),
                         )
-                        ep_ret[i] = 0.0
-                        ep_len[i] = 0
+                    ep_ret[ended] = 0.0
+                    ep_len[ended] = 0
                 obs = next_obs
                 env_steps_this_epoch += n
 
@@ -398,7 +402,7 @@ class Trainer:
                 window_full = (step + 1) % cfg.update_every == 0
                 if window_full:
                     chunk = shard_chunk(self._build_chunk(staging), self.mesh)
-                    staging = [[] for _ in range(n)]
+                    staging = []
                     if step > cfg.update_after:
                         self.state, self.buffer, m = self.dp.update_burst(
                             self.state, self.buffer, chunk, cfg.update_every
@@ -416,9 +420,18 @@ class Trainer:
             # --- end of epoch: metrics + checkpoint (ref :285-296) ---
             dt = time.time() - t_epoch
             t_epoch = time.time()
+            # Episode stats are aggregated across ALL processes here,
+            # once per epoch (ref exchanges them per-step over MPI
+            # point-to-point, sac/algorithm.py:262-271 — a hidden
+            # per-step barrier we deliberately hoist off the hot loop).
+            ep_ret_stats = global_statistics(episode_rewards)
+            ep_len_stats = global_statistics(episode_lengths)
             last_metrics = {
-                "episode_length": float(np.mean(episode_lengths)) if episode_lengths else 0.0,
-                "reward": float(np.mean(episode_rewards)) if episode_rewards else 0.0,
+                "episode_length": ep_len_stats["mean"],
+                "reward": ep_ret_stats["mean"],
+                "reward_std": ep_ret_stats["std"],
+                "reward_min": ep_ret_stats["min"],
+                "reward_max": ep_ret_stats["max"],
                 # one stacked fetch per loss series, not one RPC per burst
                 "loss_q": float(jnp.mean(jnp.stack(losses_q))) if losses_q else 0.0,
                 "loss_pi": float(jnp.mean(jnp.stack(losses_pi))) if losses_pi else 0.0,

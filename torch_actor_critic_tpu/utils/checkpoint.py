@@ -25,6 +25,13 @@ import orbax.checkpoint as ocp
 
 from torch_actor_critic_tpu.core.types import BufferState, TrainState
 
+# Checkpoint format version, bumped on any param-tree layout change.
+# 2: Dense submodules are named by their tensor-parallel role
+#    (``col``/``row``/``Dense_0``) instead of always ``Dense_0`` —
+#    checkpoints written before that rename have a different tree
+#    structure and cannot be restored into current models.
+CKPT_FORMAT = 2
+
 
 class Checkpointer:
     def __init__(
@@ -53,7 +60,9 @@ class Checkpointer:
         """Write checkpoint for ``epoch`` (async unless ``wait``)."""
         items = {
             "train_state": ocp.args.StandardSave(train_state),
-            "meta": ocp.args.JsonSave(dict(extra or {}, epoch=int(epoch))),
+            "meta": ocp.args.JsonSave(
+                dict(extra or {}, epoch=int(epoch), ckpt_format=CKPT_FORMAT)
+            ),
         }
         if buffer_state is not None and self.save_buffer:
             items["buffer"] = ocp.args.StandardSave(buffer_state)
@@ -79,6 +88,23 @@ class Checkpointer:
         epoch = epoch if epoch is not None else self._mgr.latest_step()
         if epoch is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        # Check the format version BEFORE the array restore, so a layout
+        # change surfaces as this message instead of an opaque Orbax
+        # tree-structure mismatch.
+        meta_probe = dict(
+            self._mgr.restore(
+                epoch, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+            )["meta"]
+        )
+        found = int(meta_probe.get("ckpt_format", 1))
+        if found != CKPT_FORMAT:
+            raise ValueError(
+                f"checkpoint at {self.directory} epoch {epoch} has format "
+                f"{found}, this build reads format {CKPT_FORMAT}: the model "
+                "parameter tree layout changed (see CKPT_FORMAT in "
+                "utils/checkpoint.py). Re-train, or restore with the "
+                "framework version that wrote it."
+            )
         items = {
             "train_state": ocp.args.StandardRestore(abstract_train_state),
             "meta": ocp.args.JsonRestore(),
