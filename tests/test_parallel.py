@@ -261,6 +261,202 @@ def test_dp_tp_hybrid_matches_dp_only():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_sp_gradient_path_matches_unsharded():
+    """VERDICT round-1 #5: the sequence axis sharded over sp in the
+    TRAINING step itself. A (dp=2, sp=2) burst over sequence models —
+    ring attention inside the actor+critic loss applies, histories
+    sharded over T, grads pmean'd over both axes — must produce the
+    same updated params as the (dp=2, sp=1) unsharded burst on
+    identical data."""
+    from torch_actor_critic_tpu.models import SequenceActor, SequenceDoubleCritic
+    from torch_actor_critic_tpu.models.sequence import xla_attention
+
+    T, obs_dim = 8, 5
+    cfg = SACConfig(batch_size=8)
+
+    def run(sp):
+        actor = SequenceActor(
+            act_dim=ACT_DIM, d_model=16, num_heads=2, num_layers=1,
+            max_len=T, attention_fn=xla_attention,
+        )
+        critic = SequenceDoubleCritic(
+            d_model=16, num_heads=2, num_layers=1, max_len=T, hidden=32,
+            attention_fn=xla_attention,
+        )
+        sac = SAC(cfg, actor, critic, ACT_DIM)
+        dp = DataParallelSAC(sac, make_mesh(dp=2, sp=sp))
+        if sp > 1:
+            assert dp.sac_sp is not None  # ring path actually engaged
+        state = dp.init_state(jax.random.key(0), jnp.zeros((T, obs_dim)))
+        buf = init_sharded_buffer(
+            64, jax.ShapeDtypeStruct((T, obs_dim), jnp.float32), ACT_DIM, dp.mesh
+        )
+        ks = jax.random.split(jax.random.key(1), 5)
+        chunk = Batch(
+            states=jax.random.normal(ks[0], (2, 16, T, obs_dim)),
+            actions=jnp.tanh(jax.random.normal(ks[1], (2, 16, ACT_DIM))),
+            rewards=jax.random.normal(ks[2], (2, 16)),
+            next_states=jax.random.normal(ks[3], (2, 16, T, obs_dim)),
+            done=jnp.zeros((2, 16)),
+        )
+        chunk = shard_chunk(chunk, dp.mesh)
+        if sp > 1:  # histories really laid out over the sp axis
+            assert len(chunk.states.sharding.device_set) == 2 * sp
+        state, buf, metrics = dp.update_burst(state, buf, chunk, 2)
+        return state, metrics
+
+    state_sp, m_sp = run(sp=2)
+    state_ref, m_ref = run(sp=1)
+    np.testing.assert_allclose(
+        float(m_sp["loss_q"]), float(m_ref["loss_q"]), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(m_sp["loss_pi"]), float(m_ref["loss_pi"]), rtol=1e-4
+    )
+    # Updated params agree to f32 collective-reduction-order noise
+    # (~1e-5), far below the ~6e-4 scale of two Adam steps.
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(state_sp.critic_params)[0],
+        jax.tree_util.tree_leaves(state_ref.critic_params),
+    ):
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=7e-5, err_msg=name
+        )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state_sp.actor_params),
+        jax.tree_util.tree_leaves(state_ref.actor_params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=7e-5)
+
+
+def test_sp_rejects_indivisible_and_oversized_histories():
+    """Ring attention with a non-shardable T (or a global T past the
+    positional table) must hard-error, not silently train on garbage
+    offsets (the trunk's own assert only sees local chunks)."""
+    import pytest
+
+    from torch_actor_critic_tpu.models import SequenceActor, SequenceDoubleCritic
+    from torch_actor_critic_tpu.models.sequence import xla_attention
+
+    obs_dim = 5
+    cfg = SACConfig(batch_size=8)
+
+    def make(t_hist, max_len):
+        actor = SequenceActor(
+            act_dim=ACT_DIM, d_model=16, num_heads=2, num_layers=1,
+            max_len=max_len, attention_fn=xla_attention,
+        )
+        critic = SequenceDoubleCritic(
+            d_model=16, num_heads=2, num_layers=1, max_len=max_len,
+            hidden=32, attention_fn=xla_attention,
+        )
+        dp = DataParallelSAC(SAC(cfg, actor, critic, ACT_DIM), make_mesh(dp=2, sp=2))
+        chunk = Batch(
+            states=jnp.zeros((2, 16, t_hist, obs_dim)),
+            actions=jnp.zeros((2, 16, ACT_DIM)),
+            rewards=jnp.zeros((2, 16)),
+            next_states=jnp.zeros((2, 16, t_hist, obs_dim)),
+            done=jnp.zeros((2, 16)),
+        )
+        return dp, chunk
+
+    dp, chunk = make(t_hist=9, max_len=32)  # 9 % sp(2) != 0
+    with pytest.raises(ValueError, match="not divisible by sp"):
+        dp._check_sp_shapes(chunk)
+    dp, chunk = make(t_hist=64, max_len=32)  # global T > max_len
+    with pytest.raises(ValueError, match="max_len"):
+        dp._check_sp_shapes(chunk)
+
+
+def test_sp_loss_gradients_match_unsharded():
+    """Adam hides uniform grad-scale errors, so check the gradients
+    themselves: critic-loss grads computed with ring attention over a
+    manual sp axis + pmean('sp') must equal the unsharded grads (this
+    is the pmean-over-sp contract DataParallelSAC relies on)."""
+    from jax.sharding import PartitionSpec as P
+
+    from torch_actor_critic_tpu.models import SequenceActor, SequenceDoubleCritic
+    from torch_actor_critic_tpu.models.sequence import xla_attention
+    from torch_actor_critic_tpu.parallel.context import make_ring_attention_fn
+    from torch_actor_critic_tpu.sac import losses
+
+    T, obs_dim, B = 8, 5, 8
+    actor = SequenceActor(
+        act_dim=ACT_DIM, d_model=16, num_heads=2, num_layers=1, max_len=T,
+        attention_fn=xla_attention,
+    )
+    critic = SequenceDoubleCritic(
+        d_model=16, num_heads=2, num_layers=1, max_len=T, hidden=32,
+        attention_fn=xla_attention,
+    )
+    ks = jax.random.split(jax.random.key(0), 8)
+    obs = jax.random.normal(ks[0], (B, T, obs_dim))
+    batch = Batch(
+        states=obs,
+        actions=jnp.tanh(jax.random.normal(ks[1], (B, ACT_DIM))),
+        rewards=jax.random.normal(ks[2], (B,)),
+        next_states=jax.random.normal(ks[3], (B, T, obs_dim)),
+        done=jnp.zeros((B,)),
+    )
+    a_params = actor.init(ks[4], obs, ks[5])
+    c_params = critic.init(ks[6], obs, batch.actions)
+
+    def critic_grads(actor_def, critic_def, batch):
+        def loss(cp):
+            out, _ = losses.critic_loss(
+                cp,
+                actor_apply=lambda p, o, k: actor_def.apply(p, o, k),
+                critic_apply=lambda p, o, a: critic_def.apply(p, o, a),
+                actor_params=a_params,
+                target_critic_params=c_params,
+                batch=batch,
+                key=ks[7],
+                alpha=0.2,
+                gamma=0.99,
+                reward_scale=1.0,
+            )
+            return out
+
+        return jax.grad(loss)(c_params)
+
+    g_ref = critic_grads(actor, critic, batch)
+
+    n = 4
+    mesh = make_mesh(dp=1, sp=n, devices=jax.devices()[:n])
+    ring = make_ring_attention_fn("sp", n)
+    actor_sp = actor.clone(attention_fn=ring, sp_axis="sp", sp_size=n)
+    critic_sp = critic.clone(attention_fn=ring, sp_axis="sp", sp_size=n)
+
+    def body(batch):
+        g = critic_grads(actor_sp, critic_sp, batch)
+        return jax.lax.pmean(g, "sp")
+
+    seq_spec = P(None, "sp", None)
+    g_sp = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                Batch(
+                    states=seq_spec, actions=P(), rewards=P(),
+                    next_states=seq_spec, done=P(),
+                ),
+            ),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )(batch)
+    for (path, r), s in zip(
+        jax.tree_util.tree_flatten_with_path(g_ref)[0],
+        jax.tree_util.tree_leaves(g_sp),
+    ):
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        np.testing.assert_allclose(
+            np.asarray(s), np.asarray(r), atol=1e-4, err_msg=name
+        )
+
+
 def test_dp1_single_device_path():
     """dp=1 must work identically (no special-casing)."""
     dp = make_dp(n_dev=1)

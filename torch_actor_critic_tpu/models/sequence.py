@@ -48,6 +48,30 @@ def default_attention(q, k, v, causal=True):
     return sdpa(q, k, v, causal=causal)
 
 
+def _sp_pos_offset(obs_seq: jax.Array, sp_axis: str | None):
+    """Global position of this device's chunk start: 0 single-device;
+    ``axis_index(sp) * T_local`` when the sequence axis is sharded."""
+    if sp_axis is None:
+        return 0
+    return jax.lax.axis_index(sp_axis) * obs_seq.shape[1]
+
+
+def _sp_last_token(h: jax.Array, sp_axis: str | None, sp_size: int):
+    """The representation of the *global* last timestep.
+
+    Single-device: ``h[:, -1]``. Under sequence sharding the global last
+    token lives on the final ``sp`` device; a masked ``psum`` broadcasts
+    it to every device so downstream heads/losses are replicated over
+    ``sp`` (same gather the acting path uses,
+    ``parallel/context.py``)."""
+    last = h[:, -1]
+    if sp_axis is None:
+        return last
+    idx = jax.lax.axis_index(sp_axis)
+    masked = jnp.where(idx == sp_size - 1, last, jnp.zeros_like(last))
+    return jax.lax.psum(masked, sp_axis)
+
+
 def xla_attention(q, k, v, causal=True):
     """Backend-portable attention (no Pallas): for modules that must
     compile on the host CPU while TPU is the default backend, e.g. the
@@ -155,6 +179,14 @@ class SequenceActor(nn.Module):
     max_len: int = 512
     act_limit: float = 1.0
     attention_fn: AttentionFn = default_attention
+    # Sequence/context parallelism: when `sp_axis` names a *manual* mesh
+    # axis (the module is being applied inside shard_map with the
+    # sequence dimension sharded over it), positional offsets and the
+    # last-token gather become sp-aware. Pair with a ring attention_fn
+    # (`parallel.context.make_ring_attention_fn`). Attributes, not
+    # params: the tree layout (and checkpoints) are unchanged.
+    sp_axis: str | None = None
+    sp_size: int = 1
 
     def setup(self):
         self._trunk = SequenceTrunk(
@@ -188,7 +220,8 @@ class SequenceActor(nn.Module):
         with_logprob: bool = True,
     ):
         unbatched, obs_seq = _auto_batch(obs_seq)
-        h = self.trunk(obs_seq)[:, -1]
+        h_all = self.trunk(obs_seq, _sp_pos_offset(obs_seq, self.sp_axis))
+        h = _sp_last_token(h_all, self.sp_axis, self.sp_size)
         action, logp = self.head(h, key, deterministic, with_logprob)
         if unbatched:
             action = jnp.squeeze(action, 0)
@@ -211,14 +244,17 @@ class SequenceCritic(nn.Module):
     max_len: int = 512
     hidden: int = 256
     attention_fn: AttentionFn = default_attention
+    sp_axis: str | None = None  # see SequenceActor.sp_axis
+    sp_size: int = 1
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, action: jax.Array) -> jax.Array:
         unbatched, obs_seq, action = _auto_batch(obs_seq, action)
-        h = SequenceTrunk(
+        h_all = SequenceTrunk(
             self.d_model, self.num_heads, self.num_layers, self.max_len,
             self.attention_fn,
-        )(obs_seq)[:, -1]
+        )(obs_seq, _sp_pos_offset(obs_seq, self.sp_axis))
+        h = _sp_last_token(h_all, self.sp_axis, self.sp_size)
         x = jnp.concatenate([h, action], axis=-1)
         x = nn.relu(Dense(self.hidden)(x))
         x = Dense(1)(x)
@@ -238,6 +274,8 @@ class SequenceDoubleCritic(nn.Module):
     hidden: int = 256
     num_qs: int = 2
     attention_fn: AttentionFn = default_attention
+    sp_axis: str | None = None  # see SequenceActor.sp_axis
+    sp_size: int = 1
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, action: jax.Array) -> jax.Array:
@@ -251,5 +289,6 @@ class SequenceDoubleCritic(nn.Module):
         )
         return ensemble(
             self.d_model, self.num_heads, self.num_layers, self.max_len,
-            self.hidden, self.attention_fn, name="ensemble",
+            self.hidden, self.attention_fn, self.sp_axis, self.sp_size,
+            name="ensemble",
         )(obs_seq, action)

@@ -104,22 +104,18 @@ def _build_context_actor_step(
     instead of re-tracing a fresh shard_map closure each time; flax
     modules and Mesh are hashable by value, so equal configs share an
     entry."""
-    from torch_actor_critic_tpu.models.sequence import SequenceActor
-
     n = mesh.shape["sp"]
-    ring_actor = actor.clone(attention_fn=make_ring_attention_fn("sp", n))
+    # The sp-aware module handles the positional offset and the masked
+    # psum last-token gather itself (models/sequence.py
+    # ``_sp_pos_offset``/``_sp_last_token``) — one shared implementation
+    # with the gradient path in ``parallel/dp.py``.
+    ring_actor = actor.clone(
+        attention_fn=make_ring_attention_fn("sp", n), sp_axis="sp", sp_size=n
+    )
 
     def body(params, obs_local, key):
-        t_local = obs_local.shape[1]
-        idx = jax.lax.axis_index("sp")
-        h = ring_actor.apply(
-            params, obs_local, idx * t_local, method=SequenceActor.trunk
-        )
-        last = jnp.where(idx == n - 1, h[:, -1], jnp.zeros_like(h[:, -1]))
-        last = jax.lax.psum(last, "sp")
         return ring_actor.apply(
-            params, last, key, deterministic, with_logprob,
-            method=SequenceActor.head,
+            params, obs_local, key, deterministic, with_logprob
         )
 
     return jax.jit(

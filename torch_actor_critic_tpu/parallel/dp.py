@@ -52,33 +52,93 @@ def _dp_specs(mesh: Mesh):
     return dp_spec, rep_spec
 
 
+def _leaf_spec(leaf, sp: int) -> P:
+    """Placement spec for one replay/chunk leaf.
+
+    Everything is sharded over ``dp`` on its leading device axis; when
+    the mesh has an ``sp`` axis, *sequence* observation leaves — float
+    arrays shaped ``(n_dev, n, T, D)`` with ``T`` divisible by ``sp`` —
+    additionally shard the history axis over ``sp``, so long-context
+    replay memory divides across the ring. Non-sequence leaves (flat
+    obs ``(n_dev, n, D)``, visual uint8 frames ``(n_dev, n, H, W, C)``,
+    actions/rewards) stay dp-only.
+    """
+    if (
+        sp > 1
+        and leaf.ndim == 4
+        and jnp.issubdtype(leaf.dtype, jnp.floating)
+        and leaf.shape[2] % sp == 0
+    ):
+        return P("dp", None, "sp")
+    return P("dp")
+
+
+def _batch_specs(batch: Batch, sp: int) -> Batch:
+    """Per-leaf PartitionSpecs for a chunk/ring ``Batch``; obs fields
+    follow :func:`_leaf_spec`, scalar fields are dp-sharded."""
+    obs = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+        lambda x: _leaf_spec(x, sp), tree
+    )
+    return Batch(
+        states=obs(batch.states),
+        actions=P("dp"),
+        rewards=P("dp"),
+        next_states=obs(batch.next_states),
+        done=P("dp"),
+    )
+
+
+def _buffer_specs(buffer: BufferState, sp: int) -> BufferState:
+    return BufferState(
+        data=_batch_specs(buffer.data, sp), ptr=P("dp"), size=P("dp")
+    )
+
+
 def init_sharded_buffer(
     capacity_per_device: int,
     obs_spec: t.Any,
     act_dim: int,
     mesh: Mesh,
+    sp: int | None = None,
 ) -> BufferState:
     """Per-device replay shards as one ``BufferState`` with a leading
     ``dp`` axis on every leaf (data ``(n_dev, cap, ...)``, ptr/size
     ``(n_dev,)``), sharded ``P('dp')`` — the analogue of the reference's
-    per-worker buffers built post-fork (ref ``main.py:141,168``).
+    per-worker buffers built post-fork (ref ``main.py:141,168``). On an
+    ``sp>1`` mesh, sequence-history leaves also shard their T axis over
+    ``sp`` (:func:`_leaf_spec`), dividing long-context buffer HBM
+    across the ring.
+
+    ``sp`` overrides the sequence-sharding factor — pass
+    ``DataParallelSAC.effective_sp`` so at-rest layout always agrees
+    with the burst's shard_map specs (a non-sequence model on an sp>1
+    mesh must keep dp-only layout or every burst would reshard).
     """
     n_dev = mesh.shape["dp"]
+    if sp is None:
+        sp = mesh.shape.get("sp", 1)
     single = init_replay_buffer(capacity_per_device, obs_spec, act_dim)
 
     def rep(x):
         return jnp.broadcast_to(x[None], (n_dev,) + x.shape)
 
     state = jax.tree_util.tree_map(rep, single)
-    sharding = NamedSharding(mesh, P("dp"))
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), state)
+    specs = _buffer_specs(state, sp)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs
+    )
 
 
-def shard_chunk(chunk: Batch, mesh: Mesh) -> Batch:
+def shard_chunk(chunk: Batch, mesh: Mesh, sp: int | None = None) -> Batch:
     """Place a host-built chunk with leading axes ``(n_dev, per_dev, ...)``
-    onto the ``dp`` axis of the mesh."""
-    sharding = NamedSharding(mesh, P("dp"))
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), chunk)
+    onto the ``dp`` (and, for sequence histories, ``sp``) mesh axes.
+    ``sp`` as in :func:`init_sharded_buffer`."""
+    if sp is None:
+        sp = mesh.shape.get("sp", 1)
+    specs = _batch_specs(chunk, sp)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), chunk, specs
+    )
 
 
 class DataParallelSAC:
@@ -97,9 +157,68 @@ class DataParallelSAC:
         self.mesh = mesh
         self.n_devices = mesh.shape["dp"]
         self.tp = mesh.shape.get("tp", 1)
+        self.sp = mesh.shape.get("sp", 1)
+        # Sequence/context parallelism in the GRADIENT path: on an sp>1
+        # mesh with sequence models (identified by their injectable
+        # attention_fn), the burst runs the actor/critic applies inside
+        # the losses with ring attention over the manual `sp` axis and
+        # histories sharded over T. Gradients then need pmean over BOTH
+        # axes: per-rank grads of the replicated loss sum to sp times
+        # the true gradient (each rank contributes its chunk's terms;
+        # verified against the unsharded path in tests/test_parallel.py).
+        self._sp_active = self.sp > 1 and hasattr(sac.actor_def, "attention_fn")
+        if self._sp_active:
+            from torch_actor_critic_tpu.parallel.context import (
+                make_ring_attention_fn,
+            )
+
+            ring = make_ring_attention_fn("sp", self.sp)
+            self.sac_sp = SAC(
+                sac.config,
+                sac.actor_def.clone(
+                    attention_fn=ring, sp_axis="sp", sp_size=self.sp
+                ),
+                sac.critic_def.clone(
+                    attention_fn=ring, sp_axis="sp", sp_size=self.sp
+                ),
+                sac.act_dim,
+            )
+        else:
+            self.sac_sp = None
         self._burst = None
         self._push = None
         self._select_action = None
+
+    @property
+    def effective_sp(self) -> int:
+        """The sequence-sharding factor actually used by the burst: the
+        mesh's ``sp`` for sequence models, else 1. Pass this to
+        :func:`shard_chunk` / :func:`init_sharded_buffer` so at-rest
+        layout matches the compiled specs."""
+        return self.sp if self._sp_active else 1
+
+    def _check_sp_shapes(self, chunk: Batch) -> None:
+        """Hard errors for the silent-garbage sp misuses: with ring
+        attention engaged, every rank's chunk MUST be a true shard of
+        the global sequence (T divisible by sp) and the global length
+        must fit the positional table (the trunk's own assert only sees
+        the local chunk; cf. the acting-path check at
+        ``parallel/context.py``)."""
+        t_global = chunk.states.shape[2]
+        if t_global % self.sp != 0:
+            raise ValueError(
+                f"sequence length {t_global} is not divisible by sp="
+                f"{self.sp}: ring attention would treat replicated "
+                "copies as distinct chunks of a longer sequence. Pad "
+                "the history or change the mesh."
+            )
+        max_len = getattr(self.sac.actor_def, "max_len", None)
+        if max_len is not None and t_global > max_len:
+            raise ValueError(
+                f"global history length {t_global} exceeds the actor's "
+                f"max_len={max_len} (positions would alias silently "
+                "under sp sharding)."
+            )
 
     # ----------------------------------------------------------- state init
 
@@ -118,10 +237,21 @@ class DataParallelSAC:
 
     # ----------------------------------------------------------- the burst
 
-    def _build_burst(self, num_updates: int):
-        sac = self.sac
+    def _build_burst(self, num_updates: int, buffer: BufferState, chunk: Batch):
+        sac = self.sac_sp if self._sp_active else self.sac
         mesh = self.mesh
-        dp_spec, rep_spec = _dp_specs(mesh)
+        _, rep_spec = _dp_specs(mesh)
+        sp = self.effective_sp
+        if self._sp_active:
+            self._check_sp_shapes(chunk)
+        # Grad/metric averaging axes: per-rank grads need pmean over dp
+        # (data-parallel shards, as the reference's mpi_avg_grads) AND —
+        # when the sequence ring is in the loss path — over sp (see
+        # __init__ note).
+        axes = ("dp", "sp") if self._sp_active else "dp"
+        manual = {"dp", "sp"} if self._sp_active else {"dp"}
+        buf_specs = _buffer_specs(buffer, sp)
+        chunk_specs = _batch_specs(chunk, sp)
 
         def burst_body(state: TrainState, buffer: BufferState, chunk: Batch):
             # Per-shard view: strip the leading device axis shard_map
@@ -131,15 +261,18 @@ class DataParallelSAC:
 
             # Decorrelate per-device noise/sampling streams — the
             # analogue of per-rank seeds (ref sac/algorithm.py:203-205).
+            # Fold in dp ONLY: all sp ranks of one replica must draw the
+            # same replay rows / action noise (the sequence is sharded,
+            # the batch is not).
             dev = jax.lax.axis_index(DataParallelSAC.AXIS)
             local = state.replace(rng=jax.random.fold_in(state.rng, dev))
-            # tp is a GSPMD *auto* axis inside this manual-dp body:
+            # tp is a GSPMD *auto* axis inside this manual body:
             # re-assert the Megatron layout and the partitioner shards
             # every matmul of the fused step, collectives included.
             local = tp_sharding.constrain(local, mesh)
 
             local, buffer, metrics = sac.update_burst(
-                local, buffer, chunk, num_updates, axis_name=DataParallelSAC.AXIS
+                local, buffer, chunk, num_updates, axis_name=axes
             )
             # Params/opt-states are replicated (pmean'd grads); restore a
             # replicated rng stream derived from the pre-burst key so the
@@ -147,7 +280,7 @@ class DataParallelSAC:
             state_out = local.replace(
                 rng=jax.random.fold_in(state.rng, jnp.uint32(0xB0057))
             )
-            metrics = jax.lax.pmean(metrics, DataParallelSAC.AXIS)
+            metrics = jax.lax.pmean(metrics, axes)
             # Re-attach the device axis for the dp-sharded outputs.
             buffer = jax.tree_util.tree_map(lambda x: x[None], buffer)
             return state_out, buffer, metrics
@@ -155,11 +288,12 @@ class DataParallelSAC:
         mapped = jax.shard_map(
             burst_body,
             mesh=mesh,
-            in_specs=(rep_spec, dp_spec, dp_spec),
-            out_specs=(rep_spec, dp_spec, rep_spec),
-            # Manual collectives over dp only; tp (and sp) stay GSPMD
-            # auto axes so with_sharding_constraint works inside.
-            axis_names={"dp"},
+            in_specs=(rep_spec, buf_specs, chunk_specs),
+            out_specs=(rep_spec, buf_specs, rep_spec),
+            # Manual collectives over dp (and sp when the ring runs in
+            # the losses); tp stays a GSPMD auto axis so
+            # with_sharding_constraint works inside.
+            axis_names=manual,
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
@@ -175,7 +309,10 @@ class DataParallelSAC:
         steps as one device dispatch. ``chunk`` leaves have leading axes
         ``(n_dev, per_dev, ...)`` (see :func:`shard_chunk`)."""
         if self._burst is None or self._burst[0] != num_updates:
-            self._burst = (num_updates, self._build_burst(num_updates))
+            self._burst = (
+                num_updates,
+                self._build_burst(num_updates, buffer, chunk),
+            )
         return self._burst[1](state, buffer, chunk)
 
     def push_chunk(self, buffer: BufferState, chunk: Batch) -> BufferState:
@@ -186,7 +323,11 @@ class DataParallelSAC:
         if self._push is None:
             from torch_actor_critic_tpu.buffer.replay import push
 
-            dp_spec, _ = _dp_specs(self.mesh)
+            sp = self.effective_sp
+            if self._sp_active:
+                self._check_sp_shapes(chunk)
+            buf_specs = _buffer_specs(buffer, sp)
+            chunk_specs = _batch_specs(chunk, sp)
 
             def body(buffer, chunk):
                 buffer = jax.tree_util.tree_map(lambda x: x[0], buffer)
@@ -198,8 +339,8 @@ class DataParallelSAC:
                 jax.shard_map(
                     body,
                     mesh=self.mesh,
-                    in_specs=(dp_spec, dp_spec),
-                    out_specs=dp_spec,
+                    in_specs=(buf_specs, chunk_specs),
+                    out_specs=buf_specs,
                     check_vma=False,
                 ),
                 donate_argnums=(0,),

@@ -243,7 +243,8 @@ class Trainer:
         self.state = self.dp.init_state(init_key, example_obs)
         per_dev_capacity = max(self.config.buffer_size // self.n_envs, 1)
         self.buffer = init_sharded_buffer(
-            per_dev_capacity, self.pool.obs_spec, self.pool.act_dim, self.mesh
+            per_dev_capacity, self.pool.obs_spec, self.pool.act_dim, self.mesh,
+            sp=self.dp.effective_sp,
         )
         self.start_epoch = 0
 
@@ -401,7 +402,10 @@ class Trainer:
                 # --- device window: push or push+update (ref :273-283) ---
                 window_full = (step + 1) % cfg.update_every == 0
                 if window_full:
-                    chunk = shard_chunk(self._build_chunk(staging), self.mesh)
+                    chunk = shard_chunk(
+                        self._build_chunk(staging), self.mesh,
+                        sp=self.dp.effective_sp,
+                    )
                     staging = []
                     if step > cfg.update_after:
                         self.state, self.buffer, m = self.dp.update_burst(
