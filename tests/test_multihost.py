@@ -1,0 +1,85 @@
+"""Two-process multi-host dryrun (round-1 missing #7).
+
+Launches two real OS processes, each a "host" with 2 virtual CPU
+devices, joined via ``jax.distributed`` over a local coordinator —
+exercising ``initialize_multihost``, a cross-process DP burst,
+``global_statistics``, coordinator gating, and collective Orbax
+save/restore (see ``torch_actor_critic_tpu/parallel/selftest.py``).
+
+This is the capability gap called out in SURVEY.md §4: the reference's
+MPI paths silently degrade to no-ops in its single-process test suite;
+here the cross-process collectives actually run.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_dryrun(tmp_path):
+    # (hang protection comes from the subprocess communicate timeout)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "PYTHONPATH": repo_root
+            + (
+                os.pathsep + env["PYTHONPATH"]
+                if os.environ.get("PYTHONPATH")
+                else ""
+            ),
+            # Keep accelerator sitecustomize hooks out of the children
+            # (same interpreter-start hazard as the env-pool spawn path).
+            "PALLAS_AXON_POOL_IPS": "",
+        }
+    )
+    procs = []
+    for pid in (0, 1):
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "torch_actor_critic_tpu.parallel.selftest",
+                    "--coordinator",
+                    f"127.0.0.1:{port}",
+                    "--processes",
+                    "2",
+                    "--process-id",
+                    str(pid),
+                    "--ckpt-dir",
+                    str(tmp_path / "ckpt"),
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd=repo_root,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"multihost dryrun hung; partial output: {outs}")
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} rc={p.returncode}:\n{out}"
+        assert f"MULTIHOST_OK proc={pid}/2" in out, out
+        assert "devices=2/4" in out, out
+    assert "coordinator=True" in outs[0] and "coordinator=False" in outs[1]

@@ -1,10 +1,16 @@
-from torch_actor_critic_tpu.parallel.mesh import make_mesh  # noqa: F401
+from torch_actor_critic_tpu.parallel.mesh import (  # noqa: F401
+    global_device_put,
+    local_dp_info,
+    make_mesh,
+)
 from torch_actor_critic_tpu.parallel.dp import (  # noqa: F401
     DataParallelSAC,
     init_sharded_buffer,
     shard_chunk,
+    shard_chunk_from_local,
 )
 from torch_actor_critic_tpu.parallel.distributed import (  # noqa: F401
+    global_statistics,
     initialize_multihost,
     is_coordinator,
 )

@@ -52,6 +52,14 @@ def initialize_multihost(
     if coordinator_address is None and not auto_env:
         logger.debug("single-host run; skipping jax.distributed.initialize")
         return
+    # Multi-process CPU (tests, debugging a multi-host topology without
+    # accelerators) needs an explicit cross-process collectives backend;
+    # gloo ships with jaxlib. TPU runs never hit this branch.
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # pragma: no cover - older jaxlib without gloo
+            logger.warning("could not enable gloo CPU collectives")
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

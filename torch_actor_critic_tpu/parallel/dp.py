@@ -43,6 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from torch_actor_critic_tpu.buffer.replay import init_replay_buffer
 from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
 from torch_actor_critic_tpu.parallel import sharding as tp_sharding
+from torch_actor_critic_tpu.parallel.mesh import global_device_put
 from torch_actor_critic_tpu.sac.algorithm import SAC, Metrics
 
 
@@ -120,25 +121,60 @@ def init_sharded_buffer(
     single = init_replay_buffer(capacity_per_device, obs_spec, act_dim)
 
     def rep(x):
-        return jnp.broadcast_to(x[None], (n_dev,) + x.shape)
+        # numpy zero-copy view, NOT jnp: a jnp.broadcast_to would
+        # materialize the (n_global_dev, cap, ...) GLOBAL buffer on one
+        # device per process before sharding — OOM that scales with pod
+        # size. The view costs nothing and global_device_put's callback
+        # only ever reads this process's rows.
+        return np.broadcast_to(np.asarray(x)[None], (n_dev,) + x.shape)
 
     state = jax.tree_util.tree_map(rep, single)
     specs = _buffer_specs(state, sp)
     return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs
+        lambda x, s: global_device_put(x, NamedSharding(mesh, s)), state, specs
     )
 
 
 def shard_chunk(chunk: Batch, mesh: Mesh, sp: int | None = None) -> Batch:
     """Place a host-built chunk with leading axes ``(n_dev, per_dev, ...)``
     onto the ``dp`` (and, for sequence histories, ``sp``) mesh axes.
-    ``sp`` as in :func:`init_sharded_buffer`."""
+    ``sp`` as in :func:`init_sharded_buffer`.
+
+    Multi-host: every process must pass the same full logical value
+    (see :func:`~torch_actor_critic_tpu.parallel.mesh.global_device_put`);
+    the trainer instead uses :func:`shard_chunk_from_local` so each
+    host only builds the rows its envs produced.
+    """
     if sp is None:
         sp = mesh.shape.get("sp", 1)
     specs = _batch_specs(chunk, sp)
     return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), chunk, specs
+        lambda x, s: global_device_put(x, NamedSharding(mesh, s)), chunk, specs
     )
+
+
+def shard_chunk_from_local(
+    chunk_local: Batch, mesh: Mesh, sp: int | None = None
+) -> Batch:
+    """Assemble the global dp-sharded chunk from PROCESS-LOCAL rows.
+
+    ``chunk_local`` leaves have leading axis = this process's dp-slice
+    count (:func:`~torch_actor_critic_tpu.parallel.mesh.local_dp_info`);
+    each host contributes only the transitions its own envs produced —
+    no global chunk is ever staged in host RAM. Single-process meshes
+    reduce exactly to :func:`shard_chunk`.
+    """
+    if sp is None:
+        sp = mesh.shape.get("sp", 1)
+    specs = _batch_specs(chunk_local, sp)
+
+    def put(x, s):
+        sharding = NamedSharding(mesh, s)
+        if sharding.is_fully_addressable:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+    return jax.tree_util.tree_map(put, chunk_local, specs)
 
 
 class DataParallelSAC:
@@ -233,7 +269,7 @@ class DataParallelSAC:
         if self.tp > 1:
             return tp_sharding.shard_params(state, self.mesh)
         rep = NamedSharding(self.mesh, P())
-        return jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), state)
+        return jax.tree_util.tree_map(lambda x: global_device_put(x, rep), state)
 
     # ----------------------------------------------------------- the burst
 

@@ -33,7 +33,58 @@ import typing as t
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, Sharding
+
+
+def local_dp_info(mesh: Mesh) -> t.Tuple[int, int]:
+    """``(n_local_slices, first_local_slice)`` of the ``dp`` axis for
+    this process.
+
+    A "slice" is one dp index (its ``tp × sp`` device block). The host
+    loop steps ONE env per *local* dp slice — each process simulates
+    only the envs whose replay shards it can address, the analogue of
+    the reference's one-env-per-MPI-rank pairing (SURVEY.md §2) without
+    the num_processes-fold redundancy of stepping the global env set
+    everywhere. Raises if a dp slice straddles processes (its buffer
+    shard would have no single owning host loop).
+    """
+    pi = jax.process_index()
+    rows = mesh.devices.reshape(mesh.shape["dp"], -1)
+    local, offset = 0, 0
+    for i in range(rows.shape[0]):
+        procs = {d.process_index for d in rows[i]}
+        if procs == {pi}:
+            if local == 0:
+                offset = i
+            local += 1
+        elif pi in procs:
+            raise ValueError(
+                f"dp slice {i} spans processes {sorted(procs)}; lay out "
+                "the mesh so each dp slice (its tp*sp block) is owned by "
+                "one process (tp*sp must divide the local device count)."
+            )
+    return local, offset
+
+
+def global_device_put(x, sharding: Sharding):
+    """``device_put`` that also works on multi-host shardings.
+
+    On a single-process mesh this is exactly ``jax.device_put``. When
+    the sharding spans processes (devices this process cannot address),
+    every process must hold the full logical value ``x`` (our
+    convention: same-seed construction everywhere, the analogue of the
+    reference's rank-0 ``Bcast``, ref ``sac/mpi.py:89-98``) and each
+    contributes just its addressable shards.
+    """
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    if hasattr(x, "dtype") and jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key):
+        # Typed PRNG keys can't round-trip through numpy; place the raw
+        # uint32 key data (replicated keys keep their spec) and re-wrap.
+        raw = global_device_put(jax.random.key_data(x), sharding)
+        return jax.random.wrap_key_data(raw, impl=jax.random.key_impl(x))
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
 
 
 def make_mesh(

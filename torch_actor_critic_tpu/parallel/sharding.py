@@ -25,6 +25,8 @@ import jax
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from torch_actor_critic_tpu.parallel.mesh import global_device_put
+
 
 def _tp_role(path: t.Tuple) -> str:
     """The layer's declared TP role, read off the parameter path.
@@ -81,7 +83,7 @@ def shard_params(params: t.Any, mesh: Mesh) -> t.Any:
     tp = mesh.shape.get("tp", 1)
     specs = tp_specs(params, tp)
     return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+        lambda x, s: global_device_put(x, NamedSharding(mesh, s)), params, specs
     )
 
 

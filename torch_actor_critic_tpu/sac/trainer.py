@@ -43,8 +43,9 @@ from torch_actor_critic_tpu.parallel import (
     DataParallelSAC,
     init_sharded_buffer,
     make_mesh,
-    shard_chunk,
+    shard_chunk_from_local,
 )
+from torch_actor_critic_tpu.parallel.mesh import local_dp_info
 from torch_actor_critic_tpu.parallel.distributed import global_statistics, is_coordinator
 from torch_actor_critic_tpu.sac.algorithm import SAC
 from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
@@ -138,7 +139,12 @@ class Trainer:
         self.env_name = env_name
         self.seed = seed
         self.mesh = mesh if mesh is not None else make_mesh()
-        self.n_envs = self.mesh.shape["dp"]
+        # One env per LOCAL dp slice: each host simulates only the envs
+        # feeding replay shards it can address (multi-host: no
+        # num_processes-fold redundant physics; single-host: all
+        # slices). Seeds/stat streams use the GLOBAL slice index so a
+        # run is invariant to how slices map onto hosts.
+        self.n_envs, self._env_offset = local_dp_info(self.mesh)
         self.tracker = tracker
         self.checkpointer = checkpointer
 
@@ -156,7 +162,7 @@ class Trainer:
         self.pool = make_env_pool(
             pool_name,
             self.n_envs,
-            base_seed=seed,
+            base_seed=seed + 10000 * self._env_offset,
             parallel=self.config.parallel_envs,
             timeout_s=self.config.env_timeout_s,
             start_method=self.config.env_start_method,
@@ -308,7 +314,12 @@ class Trainer:
         n = self.n_envs
 
         obs = self._normalize(
-            self.pool.reset_all([self.seed + 10000 * i for i in range(n)]),
+            self.pool.reset_all(
+                [
+                    self.seed + 10000 * (self._env_offset + i)
+                    for i in range(n)
+                ]
+            ),
             update=True,
         )
         ep_ret = np.zeros(n)
@@ -402,7 +413,7 @@ class Trainer:
                 # --- device window: push or push+update (ref :273-283) ---
                 window_full = (step + 1) % cfg.update_every == 0
                 if window_full:
-                    chunk = shard_chunk(
+                    chunk = shard_chunk_from_local(
                         self._build_chunk(staging), self.mesh,
                         sp=self.dp.effective_sp,
                     )
