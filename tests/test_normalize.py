@@ -1,0 +1,89 @@
+"""Welford normalizer: correctness of the online stats, Chan's merge,
+and the cross-process delta algebra behind ``sync_global`` (the real
+2-process sync runs in the multihost dryrun's selftest).
+"""
+
+import numpy as np
+
+from torch_actor_critic_tpu.utils.normalize import WelfordNormalizer
+
+DIM = 3
+
+
+def _feed(norm, data):
+    for row in data:
+        norm.normalize(row, update=True)
+    return norm
+
+
+def test_welford_matches_numpy():
+    rng = np.random.default_rng(0)
+    data = rng.normal(3.0, 2.0, (500, DIM))
+    norm = _feed(WelfordNormalizer(DIM), data)
+    np.testing.assert_allclose(norm.mean, data.mean(0), rtol=1e-10)
+    np.testing.assert_allclose(
+        norm.m2 / norm.count, data.var(0), rtol=1e-10
+    )
+
+
+def test_batched_update_equals_sequential():
+    rng = np.random.default_rng(1)
+    data = rng.normal(size=(96, DIM))
+    seq = _feed(WelfordNormalizer(DIM), data)
+    bat = WelfordNormalizer(DIM)
+    for chunk in np.split(data, 8):
+        bat.normalize(chunk, update=True)
+    np.testing.assert_allclose(bat.mean, seq.mean, rtol=1e-9)
+    np.testing.assert_allclose(bat.m2, seq.m2, rtol=1e-9)
+
+
+def test_merge_equals_pooled():
+    """Chan's merge of two disjoint streams == one normalizer fed both."""
+    rng = np.random.default_rng(2)
+    a, b = rng.normal(size=(100, DIM)), rng.normal(5.0, 3.0, (60, DIM))
+    na = _feed(WelfordNormalizer(DIM), a)
+    nb = _feed(WelfordNormalizer(DIM), b)
+    na.merge([(nb.mean, nb.m2, nb.count)])
+    pooled = _feed(WelfordNormalizer(DIM), np.concatenate([a, b]))
+    np.testing.assert_allclose(na.mean, pooled.mean, rtol=1e-9)
+    np.testing.assert_allclose(na.m2, pooled.m2, rtol=1e-8)
+    assert na.count == 160
+
+
+def test_local_delta_inverts_merge():
+    """The sync_global algebra: after a simulated sync (base snapshot),
+    _local_delta recovers exactly the post-sync samples, so repeated
+    syncs never double-count the shared base."""
+    rng = np.random.default_rng(3)
+    pre = rng.normal(size=(80, DIM))
+    post = rng.normal(2.0, 0.5, (40, DIM))
+    norm = _feed(WelfordNormalizer(DIM), pre)
+    norm._base = (norm.mean.copy(), norm.m2.copy(), norm.count)  # "sync"
+    _feed(norm, post)
+    d_mean, d_m2, d_count = norm._local_delta()
+    ref = _feed(WelfordNormalizer(DIM), post)
+    assert d_count == 40
+    np.testing.assert_allclose(d_mean, ref.mean, rtol=1e-8)
+    np.testing.assert_allclose(d_m2, ref.m2, rtol=1e-6, atol=1e-9)
+
+
+def test_sync_global_single_process_noop():
+    rng = np.random.default_rng(4)
+    norm = _feed(WelfordNormalizer(DIM), rng.normal(size=(50, DIM)))
+    mean, m2, count = norm.mean.copy(), norm.m2.copy(), norm.count
+    norm.sync_global()
+    np.testing.assert_array_equal(norm.mean, mean)
+    np.testing.assert_array_equal(norm.m2, m2)
+    assert norm.count == count
+
+
+def test_state_dict_roundtrip_resets_base():
+    rng = np.random.default_rng(5)
+    norm = _feed(WelfordNormalizer(DIM), rng.normal(size=(30, DIM)))
+    d = norm.state_dict()
+    fresh = WelfordNormalizer(DIM)
+    fresh.load_state_dict(d)
+    np.testing.assert_allclose(fresh.mean, norm.mean)
+    assert fresh.count == norm.count
+    # restored stats are the new sync base: no pending local delta
+    assert fresh._local_delta()[2] == 0

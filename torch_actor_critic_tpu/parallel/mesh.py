@@ -49,21 +49,34 @@ def local_dp_info(mesh: Mesh) -> t.Tuple[int, int]:
     shard would have no single owning host loop).
     """
     pi = jax.process_index()
-    rows = mesh.devices.reshape(mesh.shape["dp"], -1)
-    local, offset = 0, 0
+    # `mesh.devices` dims follow axis_names order; move dp to the front
+    # so the reshape groups a slice's tp*sp block regardless of where
+    # the caller put the dp axis.
+    dp_axis = mesh.axis_names.index("dp")
+    rows = np.moveaxis(mesh.devices, dp_axis, 0).reshape(
+        mesh.shape["dp"], -1
+    )
+    mine = []
     for i in range(rows.shape[0]):
         procs = {d.process_index for d in rows[i]}
         if procs == {pi}:
-            if local == 0:
-                offset = i
-            local += 1
+            mine.append(i)
         elif pi in procs:
             raise ValueError(
                 f"dp slice {i} spans processes {sorted(procs)}; lay out "
                 "the mesh so each dp slice (its tp*sp block) is owned by "
                 "one process (tp*sp must divide the local device count)."
             )
-    return local, offset
+    offset = mine[0] if mine else 0
+    if mine != list(range(offset, offset + len(mine))):
+        # Non-contiguous ownership would silently mis-attribute chunk
+        # rows to the wrong global slices (and duplicate env seeds).
+        raise ValueError(
+            f"process {pi} owns non-contiguous dp slices {mine}; use a "
+            "device order that keeps each process's slices adjacent "
+            "(make_mesh over jax.devices() does)."
+        )
+    return len(mine), offset
 
 
 def global_device_put(x, sharding: Sharding):

@@ -125,6 +125,32 @@ def run_selftest(
     assert stats["n"] == num_processes, stats
     assert stats["max"] == float(num_processes), stats
 
+    # Cross-process Welford sync: each process feeds DIFFERENT data;
+    # after sync_global both hold the pooled statistics (computable on
+    # every process since the per-process streams are seed-derived).
+    import numpy as np
+
+    from torch_actor_critic_tpu.utils.normalize import WelfordNormalizer
+
+    streams = [
+        np.random.default_rng(100 + p).normal(p, 1.0 + p, (50, obs_dim))
+        for p in range(num_processes)
+    ]
+    norm = WelfordNormalizer(obs_dim)
+    for row in streams[process_id]:
+        norm.normalize(row, update=True)
+    norm.sync_global()
+    pooled = np.concatenate(streams)
+    assert norm.count == pooled.shape[0], norm.count
+    # f32 tolerance: the allgather payload rides jax arrays (x64 off).
+    np.testing.assert_allclose(norm.mean, pooled.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(
+        norm.m2 / norm.count, pooled.var(0), rtol=1e-5
+    )
+    # Second sync with no new data must be a no-op (no double counting).
+    norm.sync_global()
+    assert norm.count == pooled.shape[0], norm.count
+
     # Collective Orbax save: EVERY process calls save (each owns shards
     # of the dp-sharded buffer); then a collective restore round-trips.
     ckpt = Checkpointer(ckpt_dir)

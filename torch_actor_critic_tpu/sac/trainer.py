@@ -247,7 +247,10 @@ class Trainer:
         # global device 0 is unaddressable on non-coordinator hosts.
         init_key = jax.device_put(init_key, jax.local_devices()[0])
         self.state = self.dp.init_state(init_key, example_obs)
-        per_dev_capacity = max(self.config.buffer_size // self.n_envs, 1)
+        # Divide by the GLOBAL dp size (n_envs is the local slice
+        # count): total replay capacity is buffer_size regardless of how
+        # many hosts the slices are spread over.
+        per_dev_capacity = max(self.config.buffer_size // self.mesh.shape["dp"], 1)
         self.buffer = init_sharded_buffer(
             per_dev_capacity, self.pool.obs_spec, self.pool.act_dim, self.mesh,
             sp=self.dp.effective_sp,
@@ -435,6 +438,11 @@ class Trainer:
             # --- end of epoch: metrics + checkpoint (ref :285-296) ---
             dt = time.time() - t_epoch
             t_epoch = time.time()
+            # Multi-host: fold every host's observation statistics into
+            # the shared global estimate (no-op single-process) so the
+            # replicated networks see identically-normalized inputs on
+            # every host.
+            self.normalizer.sync_global()
             # Episode stats are aggregated across ALL processes here,
             # once per epoch (ref exchanges them per-step over MPI
             # point-to-point, sac/algorithm.py:262-271 — a hidden

@@ -29,6 +29,10 @@ class WelfordNormalizer:
         self.m2 = np.zeros(dim, np.float64)
         self.count = 0
         self.eps = eps
+        # Snapshot of the stats at the last cross-process sync; the
+        # difference (current - base) is this process's UNSYNCED local
+        # contribution (see sync_global).
+        self._base = (self.mean.copy(), self.m2.copy(), 0)
 
     def normalize(self, x: np.ndarray, update: bool = True) -> np.ndarray:
         """Accepts one observation ``(dim,)`` or a lockstep batch
@@ -49,6 +53,70 @@ class WelfordNormalizer:
         var = self.m2 / max(self.count, 1)
         return ((x - self.mean) / np.sqrt(var + self.eps)).astype(np.float32)
 
+    # ------------------------------------------------ cross-process merge
+
+    def merge(self, others: t.Sequence[t.Tuple[np.ndarray, np.ndarray, int]]):
+        """Fold other processes' ``(mean, m2, count)`` triples into this
+        normalizer (Chan's pairwise merge — the same formula as the
+        batched update above). Used once per epoch in multi-host runs so
+        every host normalizes with GLOBAL statistics; without it each
+        host would drift to its own local-env statistics and the
+        replicated networks would see differently-scaled inputs per
+        host."""
+        for o_mean, o_m2, o_count in others:
+            if o_count == 0:
+                continue
+            o_mean = np.asarray(o_mean, np.float64)
+            o_m2 = np.asarray(o_m2, np.float64)
+            total = self.count + o_count
+            delta = o_mean - self.mean
+            self.mean = self.mean + delta * o_count / total
+            self.m2 = self.m2 + o_m2 + delta**2 * self.count * o_count / total
+            self.count = total
+
+    def _local_delta(self) -> t.Tuple[np.ndarray, np.ndarray, int]:
+        """This process's contribution since the last sync: the inverse
+        of Chan's merge applied to (current, base)."""
+        b_mean, b_m2, b_count = self._base
+        d_count = self.count - b_count
+        if d_count <= 0:
+            return np.zeros_like(self.mean), np.zeros_like(self.m2), 0
+        if b_count == 0:
+            return self.mean.copy(), self.m2.copy(), d_count
+        d_mean = (self.count * self.mean - b_count * b_mean) / d_count
+        delta = d_mean - b_mean
+        d_m2 = self.m2 - b_m2 - delta**2 * b_count * d_count / self.count
+        return d_mean, np.maximum(d_m2, 0.0), d_count
+
+    def sync_global(self) -> None:
+        """All-gather every process's UNSYNCED local delta and fold all
+        of them into the shared base, so every host holds the identical
+        GLOBAL estimate afterwards (each sample enters exactly once,
+        however many times this is called). No-op single-process;
+        callers invoke it at epoch boundaries, off the hot path."""
+        import jax
+
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        d_mean, d_m2, d_count = self._local_delta()
+        payload = np.concatenate([d_mean, d_m2, [float(d_count)]])
+        gathered = np.asarray(multihost_utils.process_allgather(payload))
+        dim = self.mean.shape[0]
+        # Restart from the shared base and fold every process's delta in
+        # process order — deterministic, so all hosts end bit-identical.
+        self.mean, self.m2, self.count = (
+            self._base[0].copy(), self._base[1].copy(), self._base[2],
+        )
+        self.merge(
+            [
+                (row[:dim], row[dim : 2 * dim], int(row[-1]))
+                for row in gathered
+            ]
+        )
+        self._base = (self.mean.copy(), self.m2.copy(), self.count)
+
     # ------------------------------------------------------- persistence
 
     def state_dict(self) -> dict:
@@ -62,6 +130,9 @@ class WelfordNormalizer:
         self.mean = np.asarray(d["mean"], np.float64)
         self.m2 = np.asarray(d["m2"], np.float64)
         self.count = int(d["count"])
+        # Every host restores the same checkpointed stats, so they are
+        # the new shared sync base.
+        self._base = (self.mean.copy(), self.m2.copy(), self.count)
 
 
 class IdentityNormalizer:
@@ -69,6 +140,9 @@ class IdentityNormalizer:
 
     def normalize(self, x: np.ndarray, update: bool = True) -> np.ndarray:
         return np.asarray(x, np.float32)
+
+    def sync_global(self) -> None:
+        pass
 
     def state_dict(self) -> dict:
         return {}
