@@ -308,54 +308,16 @@ def bench_host_envs(n_envs=4, n_steps=400, budget_s=120.0):
 
 
 def bench_torch_cpu(n_steps=300):
-    """Reference-style torch-CPU SAC update (independent implementation
-    of the same math: twin-critic Bellman MSE + squashed-Gaussian policy
-    loss + polyak), timed per gradient step incl. uniform replay
-    sampling — the measured stand-in for the unpublished reference
-    baseline."""
-    import numpy as np
+    """Reference-style torch-CPU SAC update, timed per gradient step
+    incl. uniform replay sampling — the measured stand-in for the
+    unpublished reference baseline. Same shared implementation as the
+    return-parity runs (``baselines/torch_sac.py``), so the throughput
+    and return baselines can never drift apart."""
     import torch
-    import torch.nn as nn
-    import torch.nn.functional as F
 
-    torch.set_num_threads(2)  # ref main.py:130
+    from torch_actor_critic_tpu.baselines import build_torch_sac
 
-    def mlp(sizes, out_dim):
-        layers, prev = [], sizes[0]
-        for h in sizes[1:]:
-            layers += [nn.Linear(prev, h), nn.ReLU()]
-            prev = h
-        layers.append(nn.Linear(prev, out_dim))
-        return nn.Sequential(*layers)
-
-    class TorchActor(nn.Module):
-        def __init__(self):
-            super().__init__()
-            # Linear(17,256)+ReLU+Linear(256,256); forward adds the
-            # second ReLU — a 2-hidden trunk matching the JAX Actor.
-            self.trunk = mlp([OBS_DIM, HIDDEN[0]], HIDDEN[1])
-            self.mu = nn.Linear(HIDDEN[-1], ACT_DIM)
-            self.log_std = nn.Linear(HIDDEN[-1], ACT_DIM)
-
-        def forward(self, obs):
-            h = F.relu(self.trunk(obs))
-            mu, log_std = self.mu(h), torch.clip(self.log_std(h), -20, 2)
-            std = torch.exp(log_std)
-            u = mu + std * torch.randn_like(mu)
-            a = torch.tanh(u)
-            logp = torch.distributions.Normal(mu, std).log_prob(u).sum(-1)
-            logp = logp - (2 * (np.log(2) - u - F.softplus(-2 * u))).sum(-1)
-            return a, logp
-
-    actor = TorchActor()
-    critics = [mlp([OBS_DIM + ACT_DIM, *HIDDEN], 1) for _ in range(2)]
-    targets = [mlp([OBS_DIM + ACT_DIM, *HIDDEN], 1) for _ in range(2)]
-    for c, t in zip(critics, targets):
-        t.load_state_dict(c.state_dict())
-    pi_opt = torch.optim.Adam(actor.parameters(), lr=3e-4)
-    q_opt = torch.optim.Adam(
-        [p for c in critics for p in c.parameters()], lr=3e-4
-    )
+    _, update = build_torch_sac(OBS_DIM, ACT_DIM, hidden=HIDDEN)
 
     n = 100_000
     data = {
@@ -366,35 +328,9 @@ def bench_torch_cpu(n_steps=300):
         "d": torch.zeros(n),
     }
 
-    def q_of(nets, s, a):
-        x = torch.cat([s, a], -1)
-        return [net(x).squeeze(-1) for net in nets]
-
     def step():
         idx = torch.randint(0, n, (BATCH,))
-        s, a, r, s2, d = (data[k][idx] for k in ("s", "a", "r", "s2", "d"))
-        with torch.no_grad():
-            a2, logp2 = actor(s2)
-            q_t = torch.min(*q_of(targets, s2, a2))
-            backup = r + 0.99 * (1 - d) * (q_t - 0.2 * logp2)
-        q1, q2 = q_of(critics, s, a)
-        loss_q = ((q1 - backup) ** 2).mean() + ((q2 - backup) ** 2).mean()
-        q_opt.zero_grad(); loss_q.backward(); q_opt.step()
-
-        for c in critics:
-            for p in c.parameters():
-                p.requires_grad_(False)
-        pi, logp = actor(s)
-        loss_pi = (0.2 * logp - torch.min(*q_of(critics, s, pi))).mean()
-        pi_opt.zero_grad(); loss_pi.backward(); pi_opt.step()
-        for c in critics:
-            for p in c.parameters():
-                p.requires_grad_(True)
-
-        with torch.no_grad():
-            for c, t in zip(critics, targets):
-                for pc, pt in zip(c.parameters(), t.parameters()):
-                    pt.mul_(0.995).add_(0.005 * pc)
+        update(*(data[k][idx] for k in ("s", "a", "r", "s2", "d")))
 
     for _ in range(20):  # warmup
         step()

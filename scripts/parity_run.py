@@ -53,10 +53,9 @@ def run_torch(env_name: str, steps: int, seed: int, out: str):
     import gymnasium
     import numpy as np
     import torch
-    import torch.nn as nn
-    import torch.nn.functional as F
 
-    torch.set_num_threads(2)  # ref main.py:130
+    from torch_actor_critic_tpu.baselines import build_torch_sac
+
     torch.manual_seed(seed)
     np.random.seed(seed)
 
@@ -66,52 +65,7 @@ def run_torch(env_name: str, steps: int, seed: int, out: str):
     act_limit = float(env.action_space.high[0])
     env.action_space.seed(seed)
 
-    def mlp(sizes):
-        layers = []
-        for a, b in zip(sizes[:-1], sizes[1:]):
-            layers += [nn.Linear(a, b), nn.ReLU()]
-        return nn.Sequential(*layers)
-
-    class Actor(nn.Module):
-        def __init__(self):
-            super().__init__()
-            self.trunk = mlp([obs_dim, 256, 256])
-            self.mu = nn.Linear(256, act_dim)
-            self.log_std = nn.Linear(256, act_dim)
-
-        def forward(self, obs, deterministic=False):
-            h = self.trunk(obs)
-            mu = self.mu(h)
-            log_std = torch.clip(self.log_std(h), -20, 2)
-            std = torch.exp(log_std)
-            u = mu if deterministic else mu + std * torch.randn_like(mu)
-            a = torch.tanh(u) * act_limit
-            logp = torch.distributions.Normal(mu, std).log_prob(u).sum(-1)
-            logp = logp - (2 * (np.log(2) - u - F.softplus(-2 * u))).sum(-1)
-            return a, logp
-
-    class Critic(nn.Module):
-        def __init__(self):
-            super().__init__()
-            self.q = nn.Sequential(
-                nn.Linear(obs_dim + act_dim, 256), nn.ReLU(),
-                nn.Linear(256, 256), nn.ReLU(), nn.Linear(256, 1),
-            )
-
-        def forward(self, s, a):
-            return self.q(torch.cat([s, a], -1)).squeeze(-1)
-
-    actor = Actor()
-    critics = [Critic(), Critic()]
-    targets = [Critic(), Critic()]
-    for c, t in zip(critics, targets):
-        t.load_state_dict(c.state_dict())
-        for p in t.parameters():
-            p.requires_grad_(False)
-    pi_opt = torch.optim.Adam(actor.parameters(), lr=3e-4)
-    q_opt = torch.optim.Adam(
-        [p for c in critics for p in c.parameters()], lr=3e-4
-    )
+    actor, sac_update = build_torch_sac(obs_dim, act_dim, act_limit)
 
     cap = min(1_000_000, steps)
     buf = {
@@ -123,38 +77,15 @@ def run_torch(env_name: str, steps: int, seed: int, out: str):
     }
     ptr, size = 0, 0
 
-    gamma, polyak, alpha, batch = 0.99, 0.995, 0.2, 64
+    batch = 64  # remaining ref hyperparams live in build_torch_sac
     start_steps, update_after, update_every = 1000, 1000, 50
     max_ep_len = 1000
 
     def update():
         idx = np.random.randint(0, size, batch)
-        s = torch.as_tensor(buf["s"][idx])
-        a = torch.as_tensor(buf["a"][idx])
-        r = torch.as_tensor(buf["r"][idx])
-        s2 = torch.as_tensor(buf["s2"][idx])
-        d = torch.as_tensor(buf["d"][idx])
-        with torch.no_grad():
-            a2, logp2 = actor(s2)
-            qt = torch.min(targets[0](s2, a2), targets[1](s2, a2))
-            backup = r + gamma * (1 - d) * (qt - alpha * logp2)
-        loss_q = sum(((c(s, a) - backup) ** 2).mean() for c in critics)
-        q_opt.zero_grad(); loss_q.backward(); q_opt.step()
-
-        for c in critics:
-            for p in c.parameters():
-                p.requires_grad_(False)
-        pi, logp = actor(s)
-        loss_pi = (alpha * logp - torch.min(critics[0](s, pi), critics[1](s, pi))).mean()
-        pi_opt.zero_grad(); loss_pi.backward(); pi_opt.step()
-        for c in critics:
-            for p in c.parameters():
-                p.requires_grad_(True)
-
-        with torch.no_grad():
-            for c, t in zip(critics, targets):
-                for pc, pt in zip(c.parameters(), t.parameters()):
-                    pt.mul_(polyak).add_((1 - polyak) * pc)
+        sac_update(
+            *(torch.as_tensor(buf[k][idx]) for k in ("s", "a", "r", "s2", "d"))
+        )
 
     log = episode_logger(out)
     obs, _ = env.reset(seed=seed)
@@ -230,12 +161,21 @@ def run_jax(env_name: str, steps: int, seed: int, out: str, parity_pi_obs: bool)
     from torch_actor_critic_tpu.utils.config import SACConfig
 
     steps_per_epoch = 5000
+    epochs = max(1, steps // steps_per_epoch)
+    actual_steps = epochs * steps_per_epoch
+    if actual_steps != steps:
+        print(
+            f"[parity] NOTE: --steps {steps} rounded to {actual_steps} "
+            f"({epochs} epochs x {steps_per_epoch}); the summary records "
+            "the ACTUAL step count.",
+            file=sys.stderr,
+        )
     cfg = SACConfig(
-        epochs=max(1, steps // steps_per_epoch),
+        epochs=epochs,
         steps_per_epoch=steps_per_epoch,
         parity_pi_obs=parity_pi_obs,
         max_ep_len=1000,
-        buffer_size=min(1_000_000, steps),
+        buffer_size=min(1_000_000, actual_steps),
     )
     t0 = time.time()
     tr = Trainer(env_name, cfg, mesh=make_mesh(dp=1), seed=seed)
@@ -244,7 +184,8 @@ def run_jax(env_name: str, steps: int, seed: int, out: str, parity_pi_obs: bool)
     metrics = tr.train()
     ev = tr.evaluate(episodes=10, deterministic=True)
     summary = {
-        "summary": True, "impl": "jax", "env": env_name, "steps": steps,
+        "summary": True, "impl": "jax", "env": env_name,
+        "steps": actual_steps,
         "seed": seed, "parity_pi_obs": parity_pi_obs,
         "train_return_final_epoch": metrics["reward"],
         "eval_return_mean": ev["ep_ret_mean"],

@@ -79,10 +79,13 @@ print("ASAN_EXERCISE_OK")
 def test_native_runtime_under_asan(tmp_path):
     if not sys.platform.startswith("linux"):
         pytest.skip("linux-only native runtime")
-    libasan = subprocess.run(
-        [os.environ.get("CXX", "g++"), "-print-file-name=libasan.so"],
-        capture_output=True, text=True,
-    ).stdout.strip()
+    try:
+        libasan = subprocess.run(
+            [os.environ.get("CXX", "g++"), "-print-file-name=libasan.so"],
+            capture_output=True, text=True,
+        ).stdout.strip()
+    except FileNotFoundError:
+        pytest.skip("C++ toolchain not available")
     if not libasan or not os.path.isabs(libasan):
         pytest.skip("libasan not available")
 

@@ -124,3 +124,36 @@ def test_too_small_frames_fail_loudly():
 def test_visual_evaluate(visual_trainer):
     ev = visual_trainer.evaluate(episodes=1, deterministic=True)
     assert np.isfinite(ev["ep_ret_mean"])
+
+
+def test_wall_runner_visual_training_real_env():
+    """BASELINE config 5 end-to-end on the REAL environment (round-1
+    missing #6: the visual stack had only ever trained against
+    FakeVisualEnv): CMU-humanoid wall-runner physics, real egocentric
+    64x64 camera frames through the default Atari conv geometry, burst
+    updates, uint8 frame replay. Short but genuinely end-to-end."""
+    pytest.importorskip("dm_control")
+    cfg = SACConfig(
+        hidden_sizes=(32, 32),
+        batch_size=8,
+        epochs=1,
+        steps_per_epoch=24,
+        start_steps=8,
+        update_after=8,
+        update_every=8,
+        buffer_size=200,
+        max_ep_len=100,
+        normalize_pixels=True,
+    )
+    tr = Trainer("DeepMindWallRunner-v0", cfg, mesh=make_mesh(dp=1))
+    try:
+        metrics = tr.train()
+        assert int(tr.state.step) == 16  # two bursts ran
+        assert np.isfinite(metrics["loss_q"])
+        assert tr.buffer.data.states.frame.dtype == np.uint8
+        assert int(tr.buffer.size[0]) == 24
+        # real physics produced non-degenerate features and frames
+        frames = np.asarray(tr.buffer.data.states.frame[0, :24])
+        assert frames.std() > 0
+    finally:
+        tr.close()

@@ -1,0 +1,7 @@
+"""Measured-baseline implementations (the reference publishes no
+numbers, BASELINE.md): independent PyTorch code used by ``bench.py``
+(throughput baseline) and ``scripts/parity_run.py`` (return-parity
+baseline). One implementation so the two comparisons can never drift
+apart."""
+
+from torch_actor_critic_tpu.baselines.torch_sac import build_torch_sac  # noqa: F401

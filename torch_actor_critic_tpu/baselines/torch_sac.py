@@ -1,0 +1,120 @@
+"""Independent PyTorch SAC — the measured stand-in for the reference.
+
+Same semantics and hyperparameter defaults as the reference run config
+(ref ``main.py:147-160``: alpha=0.2 fixed, gamma=0.99, polyak=0.995,
+batch 64, hidden [256,256], lr 3e-4), same squashed-Gaussian math (ref
+``networks/linear.py:39-51``) and twin-critic Bellman update (ref
+``sac/algorithm.py:30-74``), written functionally and shared by the
+throughput benchmark (``bench.py``) and the return-parity runner
+(``scripts/parity_run.py``) so the two baselines cannot drift.
+
+This module shares NO code with ``/root/reference`` — it is the
+project's own torch implementation of the published SAC equations.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+
+def build_torch_sac(
+    obs_dim: int,
+    act_dim: int,
+    act_limit: float = 1.0,
+    hidden: t.Sequence[int] = (256, 256),
+    lr: float = 3e-4,
+    alpha: float = 0.2,
+    gamma: float = 0.99,
+    polyak: float = 0.995,
+    num_threads: int = 2,
+):
+    """Build actor/critics and return ``(actor_fn, update_fn)``.
+
+    - ``actor_fn(obs_batch, deterministic=False) -> (action, logp)``
+      (torch tensors, no grad context managed by the caller);
+    - ``update_fn(s, a, r, s2, d)`` runs one full SAC gradient step
+      (critic, policy with frozen critic, polyak) on torch tensors.
+
+    ``torch.set_num_threads(num_threads)`` mirrors ref ``main.py:130``.
+    """
+    import numpy as np
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    torch.set_num_threads(num_threads)
+
+    def mlp(sizes):
+        layers = []
+        for a, b in zip(sizes[:-1], sizes[1:]):
+            layers += [nn.Linear(a, b), nn.ReLU()]
+        return nn.Sequential(*layers)
+
+    class Actor(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.trunk = mlp([obs_dim, *hidden])
+            self.mu = nn.Linear(hidden[-1], act_dim)
+            self.log_std = nn.Linear(hidden[-1], act_dim)
+
+        def forward(self, obs, deterministic=False):
+            h = self.trunk(obs)
+            mu = self.mu(h)
+            log_std = torch.clip(self.log_std(h), -20, 2)
+            std = torch.exp(log_std)
+            u = mu if deterministic else mu + std * torch.randn_like(mu)
+            a = torch.tanh(u) * act_limit
+            logp = torch.distributions.Normal(mu, std).log_prob(u).sum(-1)
+            logp = logp - (2 * (np.log(2) - u - F.softplus(-2 * u))).sum(-1)
+            return a, logp
+
+    def critic():
+        net = mlp([obs_dim + act_dim, *hidden])
+        net.append(nn.Linear(hidden[-1], 1))
+        return net
+
+    actor = Actor()
+    critics = [critic(), critic()]
+    targets = [critic(), critic()]
+    for c, tgt in zip(critics, targets):
+        tgt.load_state_dict(c.state_dict())
+        for p in tgt.parameters():
+            p.requires_grad_(False)
+    pi_opt = torch.optim.Adam(actor.parameters(), lr=lr)
+    q_opt = torch.optim.Adam(
+        [p for c in critics for p in c.parameters()], lr=lr
+    )
+
+    def q_of(nets, s, a):
+        x = torch.cat([s, a], -1)
+        return [net(x).squeeze(-1) for net in nets]
+
+    def update(s, a, r, s2, d):
+        with torch.no_grad():
+            a2, logp2 = actor(s2)
+            qt = torch.min(*q_of(targets, s2, a2))
+            backup = r + gamma * (1 - d) * (qt - alpha * logp2)
+        q1, q2 = q_of(critics, s, a)
+        loss_q = ((q1 - backup) ** 2).mean() + ((q2 - backup) ** 2).mean()
+        q_opt.zero_grad()
+        loss_q.backward()
+        q_opt.step()
+
+        for c in critics:
+            for p in c.parameters():
+                p.requires_grad_(False)
+        pi, logp = actor(s)
+        loss_pi = (alpha * logp - torch.min(*q_of(critics, s, pi))).mean()
+        pi_opt.zero_grad()
+        loss_pi.backward()
+        pi_opt.step()
+        for c in critics:
+            for p in c.parameters():
+                p.requires_grad_(True)
+
+        with torch.no_grad():
+            for c, tgt in zip(critics, targets):
+                for pc, pt in zip(c.parameters(), tgt.parameters()):
+                    pt.mul_(polyak).add_((1 - polyak) * pc)
+
+    return actor, update
