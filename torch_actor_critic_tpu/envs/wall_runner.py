@@ -50,12 +50,11 @@ class DeepMindWallRunner:
     name = "DeepMindWallRunner-v0"
 
     def __init__(self, seed: int | None = None):
-        import os
-
         # The egocentric camera needs a GL context; default to headless
         # EGL when no display is available (training boxes are headless).
-        if "MUJOCO_GL" not in os.environ and "DISPLAY" not in os.environ:
-            os.environ["MUJOCO_GL"] = "egl"
+        from torch_actor_critic_tpu.envs.wrappers import ensure_headless_gl
+
+        ensure_headless_gl()
         from dm_control.locomotion.examples import basic_cmu_2019
 
         self.env = basic_cmu_2019.cmu_humanoid_run_walls(random_state=seed)

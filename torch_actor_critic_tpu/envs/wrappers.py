@@ -62,6 +62,23 @@ class GymnasiumEnv:
         self.env.close()
 
 
+def ensure_headless_gl() -> None:
+    """Default MUJOCO_GL=egl on display-less hosts, BEFORE the first
+    dm_control import anywhere in the process.
+
+    dm_control pins its OpenGL platform at import time; if any dm env
+    (even one with no camera observables) is constructed first without
+    this, the backend latches to glfw, and a later camera env (the
+    wall-runner's egocentric view) dies with "an OpenGL platform
+    library has not been loaded". Call this before every dm_control
+    import site.
+    """
+    import os
+
+    if "MUJOCO_GL" not in os.environ and "DISPLAY" not in os.environ:
+        os.environ["MUJOCO_GL"] = "egl"
+
+
 def reseed_dm_env(env, seed: int | None) -> None:
     """Reseed a dm_control environment in place (suite or composer).
 
@@ -91,6 +108,7 @@ class DmControlEnv:
     """
 
     def __init__(self, domain: str, task: str, seed: int | None = None):
+        ensure_headless_gl()
         from dm_control import suite
 
         self.name = f"dm:{domain}:{task}"
