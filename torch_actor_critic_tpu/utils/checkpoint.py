@@ -113,8 +113,20 @@ class Checkpointer:
         # one (save_buffer may have been off). A shape/sharding mismatch
         # on a present buffer must surface, not silently resume with an
         # empty buffer — that is exactly the reference flaw (SURVEY.md
-        # §3.5) this module exists to fix.
-        saved_items = set(self._mgr.item_metadata(epoch).keys())
+        # §3.5) this module exists to fix. The metadata probe alone
+        # (keys, no arrays) makes Orbax warn that items "could not be
+        # restored" without a handler registry — misleading noise for a
+        # keys-only query, silenced here; the real restore below still
+        # surfaces every error.
+        import logging as _logging
+
+        absl_logger = _logging.getLogger("absl")
+        prev_level = absl_logger.level
+        absl_logger.setLevel(_logging.ERROR)
+        try:
+            saved_items = set(self._mgr.item_metadata(epoch).keys())
+        finally:
+            absl_logger.setLevel(prev_level)
         if abstract_buffer is not None and "buffer" in saved_items:
             items["buffer"] = ocp.args.StandardRestore(abstract_buffer)
         out = self._mgr.restore(epoch, args=ocp.args.Composite(**items))
