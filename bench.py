@@ -458,10 +458,7 @@ def main():
     if acc_sps is not None and full:
         out["sweep"] = bench_sweep()
         out["on_device"] = bench_on_device()
-        try:
-            out["attention"] = bench_attention()
-        except Exception as e:  # noqa: BLE001 — must still emit JSON
-            diagnostics.append({"attention_bench_error": repr(e)})
+        out["attention"] = bench_attention()  # guards internally
 
     # 5b. Host env-loop throughput (pool on/off) — host-side, cheap,
     # meaningful on any backend.

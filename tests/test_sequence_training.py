@@ -57,3 +57,25 @@ def test_sequence_sac_trains_end_to_end():
     ev = tr.evaluate(episodes=1)
     assert np.isfinite(ev["ep_ret_mean"])
     tr.close()
+
+
+def test_sequence_sac_trains_with_sp_sharded_histories():
+    """Capstone integration: the HOST trainer end-to-end on a (dp=2,
+    sp=2) mesh — history windows staged by the env loop, sharded over
+    the T axis at rest and in the burst, ring attention inside the loss
+    applies, grads pmean'd over {dp, sp}. The whole sp gradient path
+    driven by the real training shell, not a synthetic chunk."""
+    cfg = SACConfig(**{**SEQ_TINY, "history_len": 8})
+    tr = Trainer("Pendulum-v1", cfg, mesh=make_mesh(dp=2, sp=2), seed=2)
+    try:
+        assert tr.dp.sac_sp is not None  # ring path engaged in the burst
+        assert tr.dp.effective_sp == 2
+        # replay histories really laid out over sp
+        assert len(tr.buffer.data.states.sharding.device_set) == 4
+        metrics = tr.train()
+        assert int(tr.state.step) == 30
+        assert np.isfinite(metrics["loss_q"])
+        ev = tr.evaluate(episodes=1)
+        assert np.isfinite(ev["ep_ret_mean"])
+    finally:
+        tr.close()

@@ -207,7 +207,9 @@ def test_same_seed_runs_are_bit_identical():
             tr.close()
 
     a, b = run(), run()
-    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+    for x, y in zip(
+        jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b), strict=True
+    ):
         np.testing.assert_array_equal(x, y)
 
 
