@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench dryrun lint native clean
+.PHONY: test test-fast bench dryrun lint native clean tpu-smoke parity multihost
 
 test:
 	python -m pytest tests/ -q
@@ -12,6 +12,21 @@ test-fast:
 
 bench:
 	python bench.py
+
+# Real-chip smoke: Pallas kernels fwd+bwd, fused burst, on-device env.
+tpu-smoke:
+	python scripts/tpu_smoke.py
+
+# Return-parity runs vs the shared torch baseline (see PARITY.md).
+parity:
+	python scripts/parity_run.py --impl torch --env Pendulum-v1 \
+		--steps 30000 --out runs_parity/torch_pendulum.jsonl
+	python scripts/parity_run.py --impl jax --env Pendulum-v1 \
+		--steps 30000 --out runs_parity/jax_pendulum.jsonl
+
+# 2-process distributed dryrun (initialize_multihost, collective saves).
+multihost:
+	python -m pytest tests/test_multihost.py -q
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
