@@ -154,13 +154,18 @@ class SequentialEnvPool:
         n: int,
         base_seed: int = 0,
         seed_stride: int = 10000,
+        env_kwargs: dict | None = None,
         **_,
     ):
         from torch_actor_critic_tpu.envs.wrappers import make_env
 
         self.n = n
         self.envs = [
-            make_env(env_name, seed=base_seed + seed_stride * i)
+            make_env(
+                env_name,
+                seed=base_seed + seed_stride * i,
+                **(env_kwargs or {}),
+            )
             for i in range(n)
         ]
         e0 = self.envs[0]
@@ -267,6 +272,7 @@ def _worker_main(
     seed: int,
     conn,
     parent_pid: int,
+    env_kwargs: dict | None = None,
 ):
     """Env worker: build env, handshake spec, then serve futex commands."""
     # Workers are pure host-side env steppers. Force the CPU backend
@@ -292,7 +298,7 @@ def _worker_main(
         if lib is None:  # parent checked before spawning; defensive
             conn.send(("error", "native runtime unavailable in worker"))
             return
-        env = make_env(env_name, seed=seed)
+        env = make_env(env_name, seed=seed, **(env_kwargs or {}))
         conn.send(("spec", _spec_message(env)))
         shm_name, n, fields = conn.recv()
         shm = shared_memory.SharedMemory(name=shm_name)
@@ -316,6 +322,7 @@ class ParallelEnvPool:
         seed_stride: int = 10000,
         timeout_s: float = 120.0,
         start_method: str = "spawn",
+        env_kwargs: dict | None = None,
     ):
         from torch_actor_critic_tpu.native import load_runtime
 
@@ -329,6 +336,7 @@ class ParallelEnvPool:
         self._lib = lib
         self.n = n
         self.env_name = env_name
+        self._env_kwargs = dict(env_kwargs or {})
         self.timeout_ms = int(timeout_s * 1000)
         # spawn (default): workers never inherit the parent's live TPU
         # client/jax state across fork — env construction cost is paid
@@ -428,6 +436,7 @@ class ParallelEnvPool:
                     base_seed + seed_stride * i,
                     child_conn,
                     os.getpid(),
+                    self._env_kwargs,
                 ),
                 daemon=True,
                 name=f"tac-env-{i}",

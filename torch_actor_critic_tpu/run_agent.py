@@ -60,8 +60,11 @@ def main(argv=None):
     config = SACConfig.from_json(json.dumps(params.get("config", {})))
 
     checkpointer = Checkpointer(tracker.artifact_path("checkpoints"))
+    # Render handling (display detection, gymnasium's construction-time
+    # render_mode) lives in the Trainer, shared with the train CLI.
     trainer = Trainer(
-        env_name, config, mesh=make_mesh(dp=1), checkpointer=checkpointer
+        env_name, config, mesh=make_mesh(dp=1), checkpointer=checkpointer,
+        render=args.render,
     )
     try:
         trainer.restore(include_buffer=False)

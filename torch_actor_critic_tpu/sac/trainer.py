@@ -134,7 +134,30 @@ class Trainer:
         tracker: Tracker | None = None,
         checkpointer: Checkpointer | None = None,
         seed: int = 0,
+        env_kwargs: dict | None = None,
+        render: bool = False,
     ):
+        import os
+        import sys
+
+        # gymnasium only draws when the env is CONSTRUCTED with a
+        # render mode (unlike legacy gym's on-demand .render(), ref
+        # run_agent.py:40), and constructing "human" mode headless
+        # crashes — so rendering is decided here, once, for every
+        # entry point. dm_control-backed envs keep their own (no-op)
+        # render paths.
+        self._render_ok = False
+        if render:
+            if env_name.startswith("dm:") or is_visual_env(env_name):
+                self._render_ok = True
+            elif os.environ.get("DISPLAY") or sys.platform == "darwin":
+                env_kwargs = {**(env_kwargs or {}), "render_mode": "human"}
+                self._render_ok = True
+            else:
+                logger.warning(
+                    "rendering requested but no display is available; "
+                    "running headless"
+                )
         self.config = config or SACConfig()
         self.env_name = env_name
         self.seed = seed
@@ -166,6 +189,7 @@ class Trainer:
             parallel=self.config.parallel_envs,
             timeout_s=self.config.env_timeout_s,
             start_method=self.config.env_start_method,
+            env_kwargs=env_kwargs,
         )
         self.visual = is_visual_env(env_name)
         flat_obs = (
@@ -393,7 +417,7 @@ class Trainer:
                     )
                 )
 
-                if render and is_coordinator():
+                if render and self._render_ok and is_coordinator():
                     self.pool.render_at(0)
 
                 ended = terms | truncs | hit_cap
@@ -534,7 +558,7 @@ class Trainer:
                 ret += r
                 length += 1
                 done = terminated or truncated
-                if render:
+                if render and self._render_ok:
                     self.pool.render_at(0)
             returns.append(ret)
             lengths.append(length)

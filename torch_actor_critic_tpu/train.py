@@ -159,6 +159,7 @@ def main(argv=None):
         tracker=tracker,
         checkpointer=checkpointer,
         seed=args.seed,
+        render=args.render,
     )
     if args.run is not None and checkpointer.latest_epoch() is not None:
         start = trainer.restore()
