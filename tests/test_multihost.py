@@ -25,6 +25,67 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def test_launcher_runs_two_process_selftest(tmp_path):
+    """The mpi_fork-counterpart launcher (parallel/launch.py) drives
+    the same 2-process selftest: one command line fans out to N
+    processes wired to one coordinator via argument placeholders."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "PYTHONPATH": repo_root
+            + (
+                os.pathsep + env["PYTHONPATH"]
+                if os.environ.get("PYTHONPATH")
+                else ""
+            ),
+            "PALLAS_AXON_POOL_IPS": "",
+        }
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "torch_actor_critic_tpu.parallel.launch",
+            "--processes", "2", "--",
+            sys.executable, "-m", "torch_actor_critic_tpu.parallel.selftest",
+            "--coordinator", "{coordinator}",
+            "--processes", "{num_processes}",
+            "--process-id", "{process_id}",
+            "--ckpt-dir", str(tmp_path / "ckpt"),
+        ],
+        env=env, capture_output=True, text=True, timeout=540, cwd=repo_root,
+    )
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    assert "[p0] MULTIHOST_OK proc=0/2" in out, out
+    assert "[p1] MULTIHOST_OK proc=1/2" in out, out
+
+
+def test_launcher_fast_fails_and_passes_literal_braces():
+    """A dead rank must tear the group down promptly (not strand the
+    survivors in a collective), with the failing rank's exit code; and
+    arguments with literal braces (JSON) must pass through the
+    placeholder substitution untouched."""
+    import time
+
+    from torch_actor_critic_tpu.parallel.launch import launch
+
+    script = (
+        "import json, sys, time\n"
+        "assert json.loads(sys.argv[2]) == {'a': 1}\n"
+        "rank = int(sys.argv[1])\n"
+        "sys.exit(3) if rank == 1 else time.sleep(120)\n"
+    )
+    t0 = time.time()
+    rc = launch(
+        [sys.executable, "-c", script, "{process_id}", '{"a": 1}'],
+        num_processes=2,
+    )
+    assert rc == 3
+    assert time.time() - t0 < 60  # rank 0's 120s sleep was terminated
+
+
 def test_two_process_distributed_dryrun(tmp_path):
     # (hang protection comes from the subprocess communicate timeout)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -35,11 +35,33 @@ def initialize_multihost(
 ) -> None:
     """Join the multi-host runtime if configured; no-op otherwise.
 
-    With no arguments, relies on ``jax.distributed.initialize``'s
-    auto-detection from cluster env vars; if neither args nor env are
-    present, stays single-host.
+    With no arguments, first honors the ``TAC_COORDINATOR`` /
+    ``TAC_NUM_PROCESSES`` / ``TAC_PROCESS_ID`` variables set by the
+    local launcher (:mod:`torch_actor_critic_tpu.parallel.launch`, the
+    ``mpi_fork`` counterpart), then falls back to
+    ``jax.distributed.initialize``'s auto-detection from cluster env
+    vars; if none are present, stays single-host.
     """
     import os
+
+    if coordinator_address is None and os.environ.get("TAC_COORDINATOR"):
+        missing = [
+            v
+            for v in ("TAC_NUM_PROCESSES", "TAC_PROCESS_ID")
+            if v not in os.environ
+        ]
+        if missing:
+            raise ValueError(
+                f"TAC_COORDINATOR is set but {missing} are not; the "
+                "launcher sets all three (did it leak from a parent "
+                "shell?)"
+            )
+        coordinator_address = os.environ["TAC_COORDINATOR"]
+        # Fill only what the caller left unspecified.
+        if num_processes is None:
+            num_processes = int(os.environ["TAC_NUM_PROCESSES"])
+        if process_id is None:
+            process_id = int(os.environ["TAC_PROCESS_ID"])
 
     auto_env = any(
         v in os.environ

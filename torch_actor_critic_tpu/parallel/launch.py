@@ -1,0 +1,158 @@
+"""Local multi-process launcher — the ``mpi_fork`` counterpart.
+
+The reference self-re-execs under ``mpirun -np N`` and lets every rank
+re-run ``main()`` (ref ``sac/mpi.py:10-34``: sets ``IN_MPI``, thread-count
+hygiene env vars, waits, and kills the tree on interrupt). The JAX-native
+equivalent spawns N local processes wired to one
+``jax.distributed`` coordinator::
+
+    python -m torch_actor_critic_tpu.parallel.launch --processes 2 -- \
+        python -m torch_actor_critic_tpu.parallel.selftest --ckpt-dir /tmp/ck
+
+Each child gets ``TAC_COORDINATOR`` / ``TAC_NUM_PROCESSES`` /
+``TAC_PROCESS_ID`` env vars; a command may also use the placeholders
+``{process_id}`` / ``{num_processes}`` / ``{coordinator}`` in its
+arguments. Programs call
+:func:`~torch_actor_critic_tpu.parallel.distributed.initialize_multihost`
+with no arguments and pick the values up from the environment (or pass
+them explicitly, as the selftest does via placeholders).
+
+On real pods one process per host comes from the scheduler
+(GKE/xmanager/srun); this launcher is for local multi-process runs —
+CPU-device multihost tests, single-host multi-process debugging.
+Child output is streamed through with ``[p<i>]`` prefixes; the first
+non-zero exit (or Ctrl-C, like the reference's KeyboardInterrupt
+handler, ref ``sac/mpi.py:29-32``) tears the whole group down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _stream(proc: subprocess.Popen, idx: int) -> None:
+    for line in proc.stdout:  # type: ignore[union-attr]
+        sys.stdout.write(f"[p{idx}] {line}")
+        sys.stdout.flush()
+
+
+def launch(
+    command: list[str],
+    num_processes: int,
+    coordinator: str | None = None,
+    extra_env: dict | None = None,
+) -> int:
+    """Run ``command`` in ``num_processes`` local processes; returns the
+    first non-zero exit code (0 if all succeed)."""
+    import time
+
+    coordinator = coordinator or f"127.0.0.1:{_free_port()}"
+    procs: list[subprocess.Popen] = []
+    threads: list[threading.Thread] = []
+
+    def substitute(arg: str, i: int) -> str:
+        # ONLY the three known placeholders — commands legitimately
+        # carry literal braces (JSON args, format strings).
+        return (
+            arg.replace("{process_id}", str(i))
+            .replace("{num_processes}", str(num_processes))
+            .replace("{coordinator}", coordinator)
+        )
+
+    def terminate_group() -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    try:
+        for i in range(num_processes):
+            env = dict(os.environ)
+            env.update(
+                {
+                    "TAC_COORDINATOR": coordinator,
+                    "TAC_NUM_PROCESSES": str(num_processes),
+                    "TAC_PROCESS_ID": str(i),
+                    # Thread hygiene: N local processes oversubscribe the
+                    # host otherwise (ref sac/mpi.py:20-22 sets the same
+                    # two for its ranks).
+                    "OMP_NUM_THREADS": env.get("OMP_NUM_THREADS", "1"),
+                    "MKL_NUM_THREADS": env.get("MKL_NUM_THREADS", "1"),
+                }
+            )
+            env.update(extra_env or {})
+            p = subprocess.Popen(
+                [substitute(a, i) for a in command],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            procs.append(p)
+            t = threading.Thread(target=_stream, args=(p, i), daemon=True)
+            t.start()
+            threads.append(t)
+        # Poll the group: the FIRST non-zero exit tears everyone down
+        # (a dead rank would otherwise leave the survivors blocked in a
+        # collective forever — the reference has the same deadlock mode,
+        # ref sac/algorithm.py:262-271; we fail fast instead).
+        while True:
+            codes = [p.poll() for p in procs]
+            bad = next((c for c in codes if c not in (None, 0)), None)
+            if bad is not None:
+                terminate_group()
+                return bad
+            if all(c == 0 for c in codes):
+                return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        # Tear the group down like the reference's interrupt handler.
+        terminate_group()
+        return 130
+    finally:
+        for t in threads:
+            t.join(timeout=5)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("--processes", type=int, required=True)
+    parser.add_argument(
+        "--coordinator", default=None,
+        help="host:port (default: 127.0.0.1:<free port>)",
+    )
+    parser.add_argument(
+        "command", nargs=argparse.REMAINDER,
+        help="command to run per process (prefix with --)",
+    )
+    args = parser.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given (append: -- <program> [args...])")
+    return launch(command, args.processes, args.coordinator)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
