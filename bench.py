@@ -277,13 +277,23 @@ def bench_attention(budget_s=180.0):
         )
         g = jax.random.normal(ks[3], (b, h, t, d), jnp.float32)
 
-        fwd = jax.jit(lambda q, k, v: attention(q, k, v, causal=True))
+        # Each step folds its output back into q so iteration i+1 has a
+        # data dependency on iteration i: an async/pipelining backend
+        # (e.g. a tunneled TPU) cannot overlap the timed kernels, which
+        # previously produced physically-impossible TFLOP/s readings.
+        fwd = jax.jit(
+            lambda q, k, v: q * 0.999 + 1e-3 * attention(q, k, v, causal=True)
+        )
 
         def loss_vjp(q, k, v, g):
             _, vjp = jax.vjp(
                 lambda q, k, v: attention(q, k, v, causal=True), q, k, v
             )
-            return vjp(g)
+            # Fold ALL THREE grads into the chained output (tq == tk
+            # here, so shapes match) — returning only dq would let XLA
+            # dead-code-eliminate the dK/dV backward kernel entirely.
+            dq, dk, dv = vjp(g)
+            return q * 0.999 + 1e-3 * (dq + dk + dv)
 
         bwd = jax.jit(loss_vjp)
 
@@ -291,15 +301,17 @@ def bench_attention(budget_s=180.0):
         # fwd; bwd recomputes probs and adds dq/dk/dv matmuls (~2.5x).
         flops_fwd = 0.5 * 4 * b * h * t * t * d
         flops_bwd = 3.5 * flops_fwd  # fwd residual recompute + 2.5x bwd
-        def timed(fn, *args):
-            jax.block_until_ready(fn(*args))  # compile + calibrate
+        def timed(fn, q0, *args):
+            r = fn(q0, *args)
+            jax.block_until_ready(r)  # compile + calibrate
             t0 = time.perf_counter()
-            jax.block_until_ready(fn(*args))
+            jax.block_until_ready(fn(q0, *args))
             once = time.perf_counter() - t0
-            n = max(2, min(20, int(5.0 / max(once, 1e-4))))
+            n = max(4, min(50, int(5.0 / max(once, 1e-4))))
+            r = q0
             t0 = time.perf_counter()
             for _ in range(n):
-                r = fn(*args)
+                r = fn(r, *args)
             jax.block_until_ready(r)
             return (time.perf_counter() - t0) / n
 

@@ -72,7 +72,10 @@ def test_checkpoint_resume_full_state(trained):
     cfg = SACConfig(**TINY)
     tr2 = Trainer("Pendulum-v1", cfg, mesh=make_mesh(dp=2), checkpointer=ckpt2)
     start = tr2.restore()
-    assert start == 1  # saved at epoch 0 (e % save_every == 0 for e=0)
+    # Saves happen at e=0 (e % save_every == 0) AND at the final epoch
+    # e=1 (short runs always checkpoint their last epoch); restore picks
+    # the latest, so resume continues exactly where training stopped.
+    assert start == 2
     # Full state round-trips: a real (non-init) step counter, params
     # distinct from fresh init, and a non-empty restored buffer —
     # everything the reference's load_session loses (SURVEY.md §3.5).

@@ -258,9 +258,15 @@ def _flash_kernel(
             # Per-row logsumexp — the only forward residual the flash
             # backward needs besides (q, k, v, o). All-masked rows keep
             # lse = -inf, which the backward maps to zero probability.
-            lse_ref[0] = jnp.where(
+            # Stored broadcast across a 128-lane axis: Mosaic requires
+            # (8, 128)-tileable output blocks, so a (1, block_q) row
+            # vector is not lowerable — same layout as the reference
+            # TPU kernel's l/m residuals (jax pallas ops flash_attention,
+            # MIN_BLOCK_SIZE lanes).
+            lse = jnp.where(
                 l == 0.0, NEG_INF, m_ref[:, 0] + jnp.log(jnp.where(l == 0.0, 1.0, l))
             )
+            lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
 def _pad_head_dim(*arrays: jax.Array) -> t.Tuple[jax.Array, ...]:
@@ -317,9 +323,11 @@ def _flash_forward(
                      memory_space=pltpu.VMEM),
     ]
     if save_lse:
-        out_shape.append(jax.ShapeDtypeStruct((b * h, tq), jnp.float32))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, tq, _LANE), jnp.float32)
+        )
         out_specs.append(
-            pl.BlockSpec((1, block_q), lambda bh, iq, j: (bh, iq),
+            pl.BlockSpec((1, block_q, _LANE), lambda bh, iq, j: (bh, iq, 0),
                          memory_space=pltpu.VMEM)
         )
     outs = pl.pallas_call(
@@ -348,7 +356,7 @@ def _flash_forward(
     )(qr, kr, vr)
     out = outs[0].reshape(b, h, tq, dp)[..., :d]
     if save_lse:
-        return out, outs[1].reshape(b, h, tq)
+        return out, outs[1][:, :, 0].reshape(b, h, tq)
     return out
 
 
@@ -404,13 +412,13 @@ def _flash_bwd_dq_kernel(
         v_blk = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         p = _attn_probs(
-            q, k_blk, lse_ref[0], scale, causal, iq, j, block_q, block_k
+            q, k_blk, lse_ref[0][:, 0], scale, causal, iq, j, block_q, block_k
         )
         dpv = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dpv - delta_ref[0][:, None])
+        ds = p * (dpv - delta_ref[0][:, 0][:, None])
         dq_acc[:] += jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -453,7 +461,7 @@ def _flash_bwd_dkv_kernel(
         v_blk = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         p = _attn_probs(
-            q, k_blk, lse_ref[0], scale, causal, i, jk, block_q, block_k
+            q, k_blk, lse_ref[0][:, 0], scale, causal, i, jk, block_q, block_k
         )
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -463,7 +471,7 @@ def _flash_bwd_dkv_kernel(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dpv - delta_ref[0][:, None])
+        ds = p * (dpv - delta_ref[0][:, 0][:, None])
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -496,13 +504,19 @@ def _flash_backward(
     kr = k.reshape(b * h, tk, dp)
     vr = v.reshape(b * h, tk, dp)
     gr = g.reshape(b * h, tq, dp)
-    lse_r = lse.reshape(b * h, tq)
+    # Row stats enter the kernels broadcast across a 128-lane axis —
+    # (1, block_q) blocks are not (8, 128)-tileable on TPU (see the
+    # matching note in the forward's lse output).
+    lse_r = jnp.broadcast_to(
+        lse.reshape(b * h, tq)[:, :, None], (b * h, tq, _LANE)
+    )
+    delta = jnp.broadcast_to(delta[:, :, None], (b * h, tq, _LANE))
 
     qspec = pl.BlockSpec((1, block_q, dp), lambda bh, x, y: (bh, x, 0),
                          memory_space=pltpu.VMEM)
     kspec_dq = pl.BlockSpec((1, block_k, dp), lambda bh, iq, j: (bh, j, 0),
                             memory_space=pltpu.VMEM)
-    rowspec = pl.BlockSpec((1, block_q), lambda bh, x, y: (bh, x),
+    rowspec = pl.BlockSpec((1, block_q, _LANE), lambda bh, x, y: (bh, x, 0),
                            memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         functools.partial(
@@ -522,7 +536,7 @@ def _flash_backward(
                             memory_space=pltpu.VMEM)
     kspec_kv = pl.BlockSpec((1, block_k, dp), lambda bh, jk, i: (bh, jk, 0),
                             memory_space=pltpu.VMEM)
-    rowspec_kv = pl.BlockSpec((1, block_q), lambda bh, jk, i: (bh, i),
+    rowspec_kv = pl.BlockSpec((1, block_q, _LANE), lambda bh, jk, i: (bh, i, 0),
                               memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(

@@ -490,7 +490,12 @@ class Trainer:
             # Orbax saves of sharded arrays are collective: EVERY process
             # must call save (each host owns shards of the dp-sharded
             # buffer); rank-gating applies only to metric logging.
-            if self.checkpointer is not None and e % cfg.save_every == 0:
+            # The final epoch always saves, so short runs (< save_every
+            # epochs) still produce a checkpoint run_agent can load.
+            if self.checkpointer is not None and (
+                e % cfg.save_every == 0
+                or e == self.start_epoch + cfg.epochs - 1
+            ):
                 self.checkpointer.save(
                     e,
                     self.state,
