@@ -69,7 +69,7 @@ if len(sys.argv) > 1 and sys.argv[1] == "cpu":
     jax.config.update("jax_platforms", "cpu")
 devs = jax.devices()
 x = jnp.ones((256, 256), jnp.float32)
-jax.block_until_ready(x @ x)
+assert float((x @ x)[0, 0]) == 256.0  # host fetch = true execution barrier
 print(json.dumps({
     "platform": devs[0].platform,
     "device_kind": devs[0].device_kind,
@@ -190,15 +190,22 @@ def _make_bench_fn(obs_dim, act_dim, hidden, batch, capacity=1_000_000):
     buf = jax.jit(push, donate_argnums=(0,))(buf, chunk(1, 5000))
     burst = jax.jit(sac.update_burst, static_argnums=(3,), donate_argnums=(0, 1))
 
+    from torch_actor_critic_tpu.utils.sync import drain
+
     state, buf, m = burst(state, buf, chunk(2), BURST)  # compile + warmup
-    jax.block_until_ready(m)
+    drain(m["loss_q"])
 
     def run(n_bursts):
+        # Drain with a host fetch (utils/sync.py): each burst chains
+        # through the donated (state, buf), so fetching the last burst's
+        # loss forces the whole sequence to execute. block_until_ready
+        # is NOT a true barrier on the tunneled axon backend (observed:
+        # "878 TFLOP/s" on a 197-TFLOP/s chip before this fix).
         nonlocal state, buf
         t0 = time.perf_counter()
         for i in range(n_bursts):
             state, buf, m = burst(state, buf, chunk(10 + i), BURST)
-        jax.block_until_ready(m)
+        drain(m["loss_q"])
         return n_bursts * BURST / (time.perf_counter() - t0)
 
     return run
@@ -301,18 +308,19 @@ def bench_attention(budget_s=180.0):
         # fwd; bwd recomputes probs and adds dq/dk/dv matmuls (~2.5x).
         flops_fwd = 0.5 * 4 * b * h * t * t * d
         flops_bwd = 3.5 * flops_fwd  # fwd residual recompute + 2.5x bwd
+        from torch_actor_critic_tpu.utils.sync import drain
+
         def timed(fn, q0, *args):
-            r = fn(q0, *args)
-            jax.block_until_ready(r)  # compile + calibrate
+            drain(fn(q0, *args))  # compile + calibrate
             t0 = time.perf_counter()
-            jax.block_until_ready(fn(q0, *args))
+            drain(fn(q0, *args))
             once = time.perf_counter() - t0
             n = max(4, min(50, int(5.0 / max(once, 1e-4))))
             r = q0
             t0 = time.perf_counter()
             for _ in range(n):
                 r = fn(r, *args)
-            jax.block_until_ready(r)
+            drain(r)
             return (time.perf_counter() - t0) / n
 
         dt = timed(fwd, q, k, v)

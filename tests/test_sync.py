@@ -1,0 +1,13 @@
+"""utils/sync.drain: the host-fetch execution barrier used by all
+timing sites (see torch_actor_critic_tpu/utils/sync.py for why
+block_until_ready is not sufficient on the tunneled axon backend)."""
+def test_drain_is_a_true_barrier():
+    """drain() returns the reduced value, forcing producer execution."""
+    import jax.numpy as jnp
+
+    from torch_actor_critic_tpu.utils.sync import drain
+
+    x = jnp.arange(8.0)
+    assert drain(x) == 28.0
+    assert drain(jnp.float32(3.5)) == 3.5
+    assert drain(2) == 2.0

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from torch_actor_critic_tpu.buffer.replay import init_replay_buffer, push
 from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
 from torch_actor_critic_tpu.envs.ondevice import EnvState
+from torch_actor_critic_tpu.utils.sync import drain
 from torch_actor_critic_tpu.sac.algorithm import SAC
 
 Metrics = t.Dict[str, jax.Array]
@@ -373,16 +374,22 @@ def train_on_device(
             steps=config.steps_per_epoch,
             update_every=config.update_every,
         )
-        jax.block_until_ready(m["loss_q"])
-        dt = time.time() - t0
+        # Host-fetch drain before reading the clock (see utils/sync.py:
+        # block_until_ready is not a true barrier on the axon backend).
         metrics = {k: float(v) for k, v in m.items()}
+        dt = time.time() - t0
         metrics["env_steps_per_sec"] = (
             config.steps_per_epoch * loop.n_envs * loop.n_dp / dt
         )
         metrics["grad_steps_per_sec"] = config.steps_per_epoch / dt
         if tracker is not None and is_coordinator():
             tracker.log_metrics(metrics, e)
-        if checkpointer is not None and e % config.save_every == 0:
+        # Final epoch always saves (same contract as the host Trainer):
+        # short runs still produce a loadable checkpoint.
+        if checkpointer is not None and (
+            e % config.save_every == 0
+            or e == start_epoch + config.epochs - 1
+        ):
             checkpointer.save(e, state, buffer, extra={"config": config.to_json()})
         if not np.isfinite(metrics["loss_q"]):
             raise FloatingPointError(f"loss_q diverged at epoch {e}: {metrics}")
@@ -429,12 +436,12 @@ def benchmark_on_device(
     ts, buf, es, key, m = loop.epoch(
         ts, buf, es, key, steps=steps, update_every=update_every
     )
-    jax.block_until_ready(m["loss_q"])
+    drain(m["loss_q"])
     t0 = time.perf_counter()
     ts, buf, es, key, m = loop.epoch(
         ts, buf, es, key, steps=steps, update_every=update_every
     )
-    jax.block_until_ready(m["loss_q"])
+    drain(m["loss_q"])
     dt = time.perf_counter() - t0
     return {
         "env": aliases.get(env_name, env_name),

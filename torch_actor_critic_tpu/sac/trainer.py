@@ -51,6 +51,7 @@ from torch_actor_critic_tpu.sac.algorithm import SAC
 from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
 from torch_actor_critic_tpu.utils.config import SACConfig
 from torch_actor_critic_tpu.utils.normalize import IdentityNormalizer, WelfordNormalizer
+from torch_actor_critic_tpu.utils.sync import drain
 from torch_actor_critic_tpu.utils.tracking import Tracker
 
 logger = logging.getLogger(__name__)
@@ -460,6 +461,19 @@ class Trainer:
                 step += 1
 
             # --- end of epoch: metrics + checkpoint (ref :285-296) ---
+            # Drain queued device work BEFORE taking the epoch time (see
+            # utils/sync.py). The last burst's loss chains through every
+            # update this epoch. A pure-rollout epoch (no updates yet)
+            # drains through buffer.size: size is an output of the same
+            # XLA executable as the row scatters and chains through
+            # every prior push, and executables run atomically — a
+            # backend cannot deliver one output without executing the
+            # program (unlike block_until_ready's event signaling, which
+            # is what the axon tunnel gets wrong).
+            if losses_q:
+                drain(losses_q[-1])
+            else:
+                drain(self.buffer.size)
             dt = time.time() - t_epoch
             t_epoch = time.time()
             # Multi-host: fold every host's observation statistics into

@@ -1,0 +1,42 @@
+"""Device-queue drain for honest wall-clock timing.
+
+``jax.block_until_ready`` is the documented way to wait for async
+dispatch, but on the tunneled ``axon`` TPU backend it can return before
+the queued work actually executes — which silently inflates any
+throughput measured as work/elapsed (observed as a physically
+impossible "878 TFLOP/s" on a 197-TFLOP/s chip). A host *fetch* of a
+scalar that data-depends on the work is a true barrier on every
+backend: the bytes cannot arrive before the producer ran.
+
+Every timing site in the framework (bench.py sections, the trainer's
+per-epoch steps/sec metrics, the fused on-device loop benchmark) drains
+through :func:`drain` instead of ``block_until_ready``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["drain"]
+
+
+def drain(x) -> float:
+    """Force execution of everything ``x`` depends on; return a float.
+
+    ``x`` may be any array (it is reduced to one scalar on device, so
+    only a few bytes cross the wire) or an already-scalar value. The
+    returned float is the reduced value — usable as a checksum, but the
+    point is the side effect: when this returns, the producer chain has
+    executed.
+    """
+    if isinstance(x, jax.Array):
+        if not x.is_fully_addressable:
+            # Multi-host sharded array: a global reduce would need a
+            # collective outside jit. Fetching this process's first
+            # local shard drains the local device queue, which is all a
+            # local wall-clock needs.
+            shard = x.addressable_shards[0].data
+            return float(jnp.sum(shard))
+        return float(jnp.sum(x))
+    return float(x)
