@@ -431,6 +431,67 @@ def peak_flops_for(device_kind):
     return None
 
 
+def _stage_headline():
+    """Subprocess entry: headline accelerator number only."""
+    return {"acc_sps": bench_accelerator()}
+
+
+def _stage_extras():
+    """Subprocess entry: sweep + on-device + attention sections."""
+    return {
+        "sweep": bench_sweep(),
+        "on_device": bench_on_device(),
+        "attention": bench_attention(),
+    }
+
+
+_STAGES = {"headline": _stage_headline, "extras": _stage_extras}
+
+
+def _run_stage_inprocess(name):
+    """Child-process mode: run one stage, print one JSON line, exit 0."""
+    # Honor the parent's preflight decision: if it fell back to CPU, a
+    # fresh import here would still default to the (dead) accelerator.
+    _ensure_platform(os.environ.get("TAC_BENCH_CHILD_PLATFORM"))
+    try:
+        result = _STAGES[name]()
+    except Exception as e:  # noqa: BLE001 — structured over traceback
+        result = {"error": repr(e)}
+    print(json.dumps(result), flush=True)
+
+
+def run_stage_subprocess(name, timeout_s, diagnostics, platform=None):
+    """Run a bench stage in a subprocess with a hard timeout.
+
+    The round-1 bench died when the TPU backend failed at init; the
+    preflight fixed that, but a tunnel that dies MID-bench (observed
+    this round: preflight ok, then every TPU op hangs forever) would
+    still wedge the parent. A subprocess + timeout turns any hang into
+    a structured diagnostic instead of a lost round.
+    """
+    env = dict(os.environ)
+    if platform:
+        env["TAC_BENCH_CHILD_PLATFORM"] = platform
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), f"--stage={name}"],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        if proc.returncode == 0 and line:
+            return json.loads(line)
+        diagnostics.append({
+            f"{name}_stage_rc": proc.returncode,
+            "stderr_tail": proc.stderr[-500:],
+        })
+    except subprocess.TimeoutExpired:
+        diagnostics.append({f"{name}_stage_error": f"timeout after {timeout_s}s"})
+        log(f"stage {name} timed out ({timeout_s}s) — tunnel hang?")
+    except Exception as e:  # noqa: BLE001
+        diagnostics.append({f"{name}_stage_error": repr(e)})
+    return None
+
+
 def main():
     out = {
         "metric": "sac_grad_steps_per_sec",
@@ -448,16 +509,20 @@ def main():
     if pf_diags:
         diagnostics.append({"preflight": pf_diags})
 
-    # 2. Accelerator benchmark FIRST (the number that matters).
+    # 2. Accelerator benchmark FIRST (the number that matters), in a
+    # subprocess so a mid-bench tunnel hang cannot wedge the parent.
     acc_sps = None
     if info.get("platform") not in (None, "none"):
-        try:
-            acc_sps = bench_accelerator()
+        res = run_stage_subprocess(
+            "headline", 600, diagnostics, platform=info.get("platform")
+        )
+        if res and "acc_sps" in res:
+            acc_sps = res["acc_sps"]
             out["value"] = round(acc_sps, 1)
             log(f"accelerator: {acc_sps:.1f} grad-steps/s ({info.get('platform')})")
-        except Exception as e:  # noqa: BLE001 — must still emit JSON
-            diagnostics.append({"accelerator_bench_error": repr(e)})
-            log(f"accelerator bench failed: {e!r}")
+        elif res:
+            diagnostics.append({"accelerator_bench_error": res.get("error")})
+            log(f"accelerator bench failed: {res.get('error')}")
 
     # 3. MFU (analytic FLOPs; negligible-elementwise approximation).
     flops = sac_flops_per_step()
@@ -476,9 +541,15 @@ def main():
     # on a real accelerator (TAC_BENCH_FULL=1 overrides for testing).
     full = info.get("platform") != "cpu" or os.environ.get("TAC_BENCH_FULL") == "1"
     if acc_sps is not None and full:
-        out["sweep"] = bench_sweep()
-        out["on_device"] = bench_on_device()
-        out["attention"] = bench_attention()  # guards internally
+        res = run_stage_subprocess(
+            "extras", 900, diagnostics, platform=info.get("platform")
+        )
+        if res and "error" in res:
+            # Route child-reported failure to diagnostics — a top-level
+            # "error" key is reserved for total bench failure.
+            diagnostics.append({"extras_stage_error": res.pop("error")})
+        if res:
+            out.update(res)
 
     # 5b. Host env-loop throughput (pool on/off) — host-side, cheap,
     # meaningful on any backend.
@@ -510,6 +581,9 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1].startswith("--stage="):
+        _run_stage_inprocess(sys.argv[1].split("=", 1)[1])
+        sys.exit(0)
     try:
         main()
     except BaseException as e:  # noqa: BLE001 — last-resort structured line

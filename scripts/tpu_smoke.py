@@ -102,6 +102,90 @@ def smoke_burst():
     assert bool(jnp.isfinite(m["loss_q"])), m
 
 
+@stage("sequence-SAC update_burst (flash fwd+bwd in the training path)")
+def smoke_sequence_burst():
+    import jax
+    import jax.numpy as jnp
+
+    from torch_actor_critic_tpu.buffer import init_replay_buffer, push
+    from torch_actor_critic_tpu.core.types import Batch
+    from torch_actor_critic_tpu.models import SequenceActor, SequenceDoubleCritic
+    from torch_actor_critic_tpu.sac import SAC
+    from torch_actor_critic_tpu.utils.config import SACConfig
+
+    horizon, obs_dim, act_dim = 16, 3, 1
+    cfg = SACConfig(batch_size=32, history_len=horizon)
+    actor = SequenceActor(act_dim=act_dim, max_len=horizon)
+    critic = SequenceDoubleCritic(max_len=horizon)
+    sac = SAC(cfg, actor, critic, act_dim)
+    state = sac.init_state(jax.random.key(0), jnp.zeros((horizon, obs_dim)))
+    buf = init_replay_buffer(
+        2_000, jax.ShapeDtypeStruct((horizon, obs_dim), jnp.float32), act_dim
+    )
+    ks = jax.random.split(jax.random.key(1), 5)
+    chunk = Batch(
+        states=jax.random.normal(ks[0], (200, horizon, obs_dim)),
+        actions=jnp.tanh(jax.random.normal(ks[1], (200, act_dim))),
+        rewards=jax.random.normal(ks[2], (200,)),
+        next_states=jax.random.normal(ks[3], (200, horizon, obs_dim)),
+        done=jnp.zeros((200,)),
+    )
+    buf = jax.jit(push, donate_argnums=(0,))(buf, chunk)
+    state, buf, m = jax.jit(sac.update_burst, static_argnums=(3,))(
+        state, buf, chunk, 10
+    )
+    assert bool(jnp.isfinite(m["loss_q"])), m
+    assert bool(jnp.isfinite(m["loss_pi"])), m
+
+
+@stage("visual update_burst at wall-runner geometry (NHWC uint8 on chip)")
+def smoke_visual_burst():
+    import jax
+    import jax.numpy as jnp
+
+    from torch_actor_critic_tpu.buffer import init_visual_replay_buffer, push
+    from torch_actor_critic_tpu.core.types import Batch, MultiObservation
+    from torch_actor_critic_tpu.models import VisualActor, VisualDoubleCritic
+    from torch_actor_critic_tpu.sac import SAC
+    from torch_actor_critic_tpu.utils.config import SACConfig
+
+    # The real wall-runner observation geometry (BASELINE config 5):
+    # 168 proprioceptive features + a 64x64x3 uint8 egocentric frame.
+    feat, frame, act_dim, n = 168, (64, 64, 3), 56, 128
+    cfg = SACConfig(batch_size=32)
+    sac = SAC(
+        cfg, VisualActor(act_dim=act_dim), VisualDoubleCritic(), act_dim
+    )
+    state = sac.init_state(
+        jax.random.key(0),
+        MultiObservation(
+            features=jnp.zeros((feat,)), frame=jnp.zeros(frame, jnp.uint8)
+        ),
+    )
+    buf = init_visual_replay_buffer(2_000, feat, frame, act_dim)
+    ks = jax.random.split(jax.random.key(1), 6)
+
+    def obs(key_f, key_p):
+        return MultiObservation(
+            features=jax.random.normal(key_f, (n, feat)),
+            frame=jax.random.randint(key_p, (n, *frame), 0, 256, jnp.uint8),
+        )
+
+    chunk = Batch(
+        states=obs(ks[0], ks[1]),
+        actions=jnp.tanh(jax.random.normal(ks[2], (n, act_dim))),
+        rewards=jax.random.normal(ks[3], (n,)),
+        next_states=obs(ks[4], ks[5]),
+        done=jnp.zeros((n,)),
+    )
+    buf = jax.jit(push, donate_argnums=(0,))(buf, chunk)
+    state, buf, m = jax.jit(sac.update_burst, static_argnums=(3,))(
+        state, buf, chunk, 10
+    )
+    assert bool(jnp.isfinite(m["loss_q"])), m
+    assert bool(jnp.isfinite(m["loss_pi"])), m
+
+
 @stage("on-device HalfCheetah-twin fused epoch")
 def smoke_ondevice():
     from torch_actor_critic_tpu.sac.ondevice import benchmark_on_device
@@ -121,6 +205,8 @@ def main():
     print(f"devices: {jax.devices()}", flush=True)
     smoke_flash()
     smoke_burst()
+    smoke_sequence_burst()
+    smoke_visual_burst()
     smoke_ondevice()
     if FAILURES:
         print(f"FAILED stages: {FAILURES}", flush=True)
