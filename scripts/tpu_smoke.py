@@ -7,7 +7,11 @@ on the real chip (no platform forcing):
 1. flash attention forward+backward (Mosaic compile) vs the dense
    reference, causal and non-causal, head-dim padding;
 2. one fused SAC update_burst at the benchmark configuration;
-3. one fused on-device HalfCheetah-twin epoch.
+3. a sequence-SAC update_burst (flash attention fwd+bwd inside the
+   actual training path);
+4. a visual update_burst at the real wall-runner geometry (168
+   features + 64x64x3 uint8 frames, act_dim 56, NHWC convs);
+5. one fused on-device HalfCheetah-twin epoch.
 
 Prints one OK/FAIL line per stage and exits non-zero on any failure.
 Run: ``python scripts/tpu_smoke.py`` (first compile ~20-40s).
