@@ -1,0 +1,21 @@
+#!/bin/bash
+# Poll the TPU tunnel; when it answers, run the real-chip smoke and the
+# full benchmark, teeing results to /tmp/tpu_recovery_{smoke,bench}.log.
+# One-shot: exits after the first successful (or failed) run pair.
+set -u
+for i in $(seq 1 60); do
+    if timeout 75 python -c "
+import jax, jax.numpy as jnp
+assert float((jnp.ones((8,8))@jnp.ones((8,8)))[0,0]) == 8.0
+" >/dev/null 2>&1; then
+        echo "[tpu_watch] tunnel alive after $i probes; running smoke+bench"
+        timeout 900 python scripts/tpu_smoke.py 2>&1 | tail -12 | tee /tmp/tpu_recovery_smoke.log
+        timeout 2400 python bench.py 2>/tmp/tpu_recovery_bench.stderr | tee /tmp/tpu_recovery_bench.log
+        echo "[tpu_watch] done"
+        exit 0
+    fi
+    echo "[tpu_watch] probe $i: tunnel still down"
+    sleep 300
+done
+echo "[tpu_watch] gave up after 60 probes"
+exit 1
