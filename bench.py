@@ -159,7 +159,8 @@ def sac_flops_per_step(batch=BATCH, hidden=HIDDEN, obs=OBS_DIM, act=ACT_DIM):
     return 2 * batch * macs
 
 
-def _make_bench_fn(obs_dim, act_dim, hidden, batch, capacity=1_000_000):
+def _make_bench_fn(obs_dim, act_dim, hidden, batch, capacity=1_000_000,
+                   compute_dtype="float32"):
     import jax
     import jax.numpy as jnp
 
@@ -169,9 +170,12 @@ def _make_bench_fn(obs_dim, act_dim, hidden, batch, capacity=1_000_000):
     from torch_actor_critic_tpu.sac import SAC
     from torch_actor_critic_tpu.utils.config import SACConfig
 
-    cfg = SACConfig(batch_size=batch, hidden_sizes=hidden)
-    sac = SAC(cfg, Actor(act_dim=act_dim, hidden_sizes=hidden),
-              DoubleCritic(hidden_sizes=hidden), act_dim)
+    cfg = SACConfig(
+        batch_size=batch, hidden_sizes=hidden, compute_dtype=compute_dtype
+    )
+    dt = cfg.model_dtype
+    sac = SAC(cfg, Actor(act_dim=act_dim, hidden_sizes=hidden, dtype=dt),
+              DoubleCritic(hidden_sizes=hidden, dtype=dt), act_dim)
     state = sac.init_state(jax.random.key(0), jnp.zeros((obs_dim,)))
     buf = init_replay_buffer(
         capacity, jax.ShapeDtypeStruct((obs_dim,), jnp.float32), act_dim
@@ -222,10 +226,11 @@ def _make_bench_fn(obs_dim, act_dim, hidden, batch, capacity=1_000_000):
     return run
 
 
-def bench_accelerator():
+def bench_accelerator(compute_dtype="float32"):
     """Headline number: grad-steps/sec at the reference config through
     the real fused update_burst path."""
-    run = _make_bench_fn(OBS_DIM, ACT_DIM, HIDDEN, BATCH)
+    run = _make_bench_fn(OBS_DIM, ACT_DIM, HIDDEN, BATCH,
+                         compute_dtype=compute_dtype)
     run(5)  # extra warmup beyond compile
     return run(60)
 
@@ -235,23 +240,30 @@ def bench_sweep(budget_s=240.0):
     latency-bound. Best-effort within a time budget."""
     results = []
     t_start = time.time()
-    for batch, hidden in [(512, HIDDEN), (4096, HIDDEN), (4096, (1024, 1024))]:
+    for batch, hidden, dtype in [
+        (512, HIDDEN, "float32"),
+        (4096, HIDDEN, "float32"),
+        (4096, (1024, 1024), "float32"),
+        (4096, (1024, 1024), "bfloat16"),
+    ]:
         if time.time() - t_start > budget_s:
             log("sweep budget exhausted; truncating")
             break
+        entry = {"batch": batch, "hidden": list(hidden), "dtype": dtype}
         try:
-            run = _make_bench_fn(OBS_DIM, ACT_DIM, hidden, batch, capacity=100_000)
+            run = _make_bench_fn(OBS_DIM, ACT_DIM, hidden, batch,
+                                 capacity=100_000, compute_dtype=dtype)
             sps = run(2)  # calibration; re-measure properly only if fast
             if BURST * 20 / sps < (budget_s - (time.time() - t_start)):
                 sps = run(20)
-            results.append({
-                "batch": batch, "hidden": list(hidden),
+            entry.update({
                 "grad_steps_per_sec": round(sps, 1),
                 "examples_per_sec": round(sps * batch, 0),
             })
-            log(f"sweep batch={batch} hidden={hidden}: {sps:.1f} steps/s")
+            log(f"sweep batch={batch} hidden={hidden} {dtype}: {sps:.1f} steps/s")
         except Exception as e:  # noqa: BLE001 — sweep is best-effort
-            results.append({"batch": batch, "hidden": list(hidden), "error": repr(e)})
+            entry["error"] = repr(e)
+        results.append(entry)
     return results
 
 
@@ -448,12 +460,20 @@ def peak_flops_for(device_kind):
 
 
 def _stage_headline():
-    """Subprocess entry: headline accelerator number only."""
+    """Subprocess entry: headline (parity-config, float32) number."""
     return {"acc_sps": bench_accelerator()}
+
+
+def _stage_headline_bf16():
+    """Subprocess entry: the same burst with compute_dtype=bfloat16
+    (MXU-native matmuls, f32 params/optimizer/losses). Its own stage so
+    a bf16 hang cannot cost the already-measured f32 headline."""
+    return {"acc_sps_bf16": bench_accelerator(compute_dtype="bfloat16")}
 
 
 _STAGES = {
     "headline": _stage_headline,
+    "headline_bf16": _stage_headline_bf16,
     "sweep": lambda: {"sweep": bench_sweep()},
     "on_device": lambda: {"on_device": bench_on_device()},
     "attention": lambda: {"attention": bench_attention()},
@@ -535,6 +555,14 @@ def main():
         elif res:
             diagnostics.append({"accelerator_bench_error": res.get("error")})
             log(f"accelerator bench failed: {res.get('error')}")
+        res = run_stage_subprocess(
+            "headline_bf16", 600, diagnostics, platform=info.get("platform")
+        )
+        if res and "acc_sps_bf16" in res:
+            out["value_bf16"] = round(res["acc_sps_bf16"], 1)
+            log(f"accelerator bf16: {out['value_bf16']} grad-steps/s")
+        elif res:
+            diagnostics.append({"bf16_bench_error": res.get("error")})
 
     # 3. MFU (analytic FLOPs; negligible-elementwise approximation).
     flops = sac_flops_per_step()

@@ -113,3 +113,99 @@ def test_double_critic_matches_stacked_single_critics():
         {"params": member0["params"]["ensemble"]}, obs, act
     )
     np.testing.assert_allclose(np.asarray(q[0]), np.asarray(q0), rtol=1e-6)
+
+
+class TestBfloat16Compute:
+    """compute_dtype=bfloat16: matmuls in bf16, params/outputs float32.
+
+    The torch reference has no mixed-precision path; this is the
+    MXU-native extension (SACConfig.compute_dtype).
+    """
+
+    def test_params_stay_float32_and_outputs_are_float32(self):
+        actor = Actor(act_dim=ACT_DIM, dtype=jnp.bfloat16)
+        obs = jax.random.normal(jax.random.key(1), (4, OBS_DIM))
+        params = actor.init(jax.random.key(0), obs, jax.random.key(2))
+        for leaf in jax.tree_util.tree_leaves(params):
+            assert leaf.dtype == jnp.float32, leaf.dtype
+        action, logp = actor.apply(params, obs, jax.random.key(3))
+        assert action.dtype == jnp.float32 and logp.dtype == jnp.float32
+
+    def test_bf16_forward_close_to_f32(self):
+        """Same params, bf16 vs f32 compute: outputs within bf16 noise."""
+        f32 = DoubleCritic(hidden_sizes=(64, 64))
+        bf16 = DoubleCritic(hidden_sizes=(64, 64), dtype=jnp.bfloat16)
+        obs = jax.random.normal(jax.random.key(1), (8, OBS_DIM))
+        act = jax.random.normal(jax.random.key(2), (8, ACT_DIM))
+        params = f32.init(jax.random.key(0), obs, act)
+        q32 = f32.apply(params, obs, act)
+        q16 = bf16.apply(params, obs, act)
+        assert q16.dtype == jnp.float32
+        np.testing.assert_allclose(
+            np.asarray(q16), np.asarray(q32), rtol=0.05, atol=0.05
+        )
+
+    def test_bf16_update_burst_trains(self):
+        """A full fused burst in bf16 produces finite losses and f32 state."""
+        from torch_actor_critic_tpu.buffer import init_replay_buffer, push
+        from torch_actor_critic_tpu.core.types import Batch
+        from torch_actor_critic_tpu.sac import SAC
+        from torch_actor_critic_tpu.utils.config import SACConfig
+
+        cfg = SACConfig(batch_size=16, hidden_sizes=(32, 32),
+                        compute_dtype="bfloat16")
+        sac = SAC(
+            cfg,
+            Actor(act_dim=ACT_DIM, hidden_sizes=(32, 32), dtype=cfg.model_dtype),
+            DoubleCritic(hidden_sizes=(32, 32), dtype=cfg.model_dtype),
+            ACT_DIM,
+        )
+        state = sac.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
+        buf = init_replay_buffer(
+            500, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32), ACT_DIM
+        )
+        ks = jax.random.split(jax.random.key(1), 5)
+        chunk = Batch(
+            states=jax.random.normal(ks[0], (100, OBS_DIM)),
+            actions=jnp.tanh(jax.random.normal(ks[1], (100, ACT_DIM))),
+            rewards=jax.random.normal(ks[2], (100,)),
+            next_states=jax.random.normal(ks[3], (100, OBS_DIM)),
+            done=jnp.zeros((100,)),
+        )
+        buf = jax.jit(push, donate_argnums=(0,))(buf, chunk)
+        state, buf, m = jax.jit(sac.update_burst, static_argnums=(3,))(
+            state, buf, chunk, 5
+        )
+        assert bool(jnp.isfinite(m["loss_q"])) and bool(jnp.isfinite(m["loss_pi"]))
+        for leaf in jax.tree_util.tree_leaves(state.actor_params):
+            assert leaf.dtype == jnp.float32
+
+    def test_config_validates_compute_dtype(self):
+        from torch_actor_critic_tpu.utils.config import SACConfig
+
+        with pytest.raises(ValueError):
+            SACConfig(compute_dtype="float16")
+
+    def test_bf16_sequence_and_visual_forward(self):
+        from torch_actor_critic_tpu.core.types import MultiObservation
+        from torch_actor_critic_tpu.models import SequenceActor, VisualActor
+
+        seq = SequenceActor(act_dim=ACT_DIM, d_model=16, num_heads=2,
+                            num_layers=1, max_len=8, dtype=jnp.bfloat16)
+        h = jax.random.normal(jax.random.key(1), (2, 8, OBS_DIM))
+        p = seq.init(jax.random.key(0), h, jax.random.key(2))
+        a, lp = seq.apply(p, h, jax.random.key(3))
+        assert a.dtype == jnp.float32 and bool(jnp.all(jnp.isfinite(lp)))
+
+        vis = VisualActor(act_dim=ACT_DIM, hidden_sizes=(16,),
+                          kernel_sizes=(3, 3, 3), strides=(2, 2, 1),
+                          dtype=jnp.bfloat16)
+        obs = MultiObservation(
+            features=jax.random.normal(jax.random.key(4), (2, 5)),
+            frame=jax.random.randint(
+                jax.random.key(5), (2, 16, 16, 3), 0, 256, jnp.uint8
+            ),
+        )
+        p = vis.init(jax.random.key(0), obs, jax.random.key(2))
+        a, lp = vis.apply(p, obs, jax.random.key(3))
+        assert a.dtype == jnp.float32 and bool(jnp.all(jnp.isfinite(lp)))

@@ -17,6 +17,7 @@ from __future__ import annotations
 import typing as t
 
 import jax
+import jax.numpy as jnp
 from flax import linen as nn
 
 from torch_actor_critic_tpu.models.mlp import MLP, Dense
@@ -36,6 +37,10 @@ class Actor(nn.Module):
     act_dim: int
     hidden_sizes: t.Sequence[int] = (256, 256)
     act_limit: float = 1.0
+    # Compute dtype for trunk/head matmuls (params stay float32). The
+    # distribution math (clip/exp/tanh/log-prob) always runs float32:
+    # exp(log_std) and the softplus correction are precision-sensitive.
+    dtype: t.Any = jnp.float32
 
     @nn.compact
     def __call__(
@@ -45,9 +50,10 @@ class Actor(nn.Module):
         deterministic: bool = False,
         with_logprob: bool = True,
     ):
-        trunk = MLP(self.hidden_sizes, activate_final=True)(obs)
-        mu = Dense(self.act_dim)(trunk)
-        log_std = Dense(self.act_dim)(trunk)
+        dtype = self.dtype
+        trunk = MLP(self.hidden_sizes, activate_final=True, dtype=dtype)(obs)
+        mu = Dense(self.act_dim, dtype=dtype)(trunk).astype(jnp.float32)
+        log_std = Dense(self.act_dim, dtype=dtype)(trunk).astype(jnp.float32)
         return squashed_gaussian_sample(
             key, mu, log_std, self.act_limit, deterministic, with_logprob
         )

@@ -28,12 +28,18 @@ class Critic(nn.Module):
     """Single Q-network: ``Q(s, a) -> scalar`` (batch-shaped)."""
 
     hidden_sizes: t.Sequence[int] = (256, 256)
+    # Compute dtype for the matmuls (params stay float32); the Q value
+    # is cast back to float32 so Bellman targets and losses are always
+    # full precision.
+    dtype: t.Any = jnp.float32
 
     @nn.compact
     def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        dtype = self.dtype
         x = jnp.concatenate([obs, action], axis=-1)
-        x = MLP(tuple(self.hidden_sizes) + (1,), activate_final=False)(x)
-        return jnp.squeeze(x, axis=-1)
+        x = MLP(tuple(self.hidden_sizes) + (1,), activate_final=False,
+                dtype=dtype)(x)
+        return jnp.squeeze(x.astype(jnp.float32), axis=-1)
 
 
 class DoubleCritic(nn.Module):
@@ -45,6 +51,7 @@ class DoubleCritic(nn.Module):
 
     hidden_sizes: t.Sequence[int] = (256, 256)
     num_qs: int = 2
+    dtype: t.Any = jnp.float32
 
     @nn.compact
     def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
@@ -56,4 +63,6 @@ class DoubleCritic(nn.Module):
             out_axes=0,
             axis_size=self.num_qs,
         )
-        return ensemble(self.hidden_sizes, name="ensemble")(obs, action)
+        return ensemble(self.hidden_sizes, dtype=self.dtype, name="ensemble")(
+            obs, action
+        )

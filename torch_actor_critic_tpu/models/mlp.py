@@ -57,6 +57,11 @@ class Dense(nn.Module):
 
     features: int
     tp_role: str = "replicate"
+    # Compute dtype for the matmul (params always stored float32 —
+    # flax's param_dtype — so optimizer state, polyak targets and
+    # checkpoints are precision-independent). bfloat16 is the MXU's
+    # native input width; see SACConfig.compute_dtype.
+    dtype: t.Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -66,6 +71,8 @@ class Dense(nn.Module):
             self.features,
             kernel_init=torch_linear_kernel_init,
             bias_init=torch_linear_bias_init(fan_in),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
             name=name,
         )(x)
 
@@ -84,12 +91,15 @@ class MLP(nn.Module):
 
     hidden_sizes: t.Sequence[int]
     activate_final: bool = True
+    dtype: t.Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         n = len(self.hidden_sizes)
         for i, width in enumerate(self.hidden_sizes):
-            x = Dense(width, tp_role="col" if i % 2 == 0 else "row")(x)
+            x = Dense(
+                width, tp_role="col" if i % 2 == 0 else "row", dtype=self.dtype
+            )(x)
             if self.activate_final or i < n - 1:
                 x = nn.relu(x)
         return x

@@ -84,9 +84,11 @@ class MultiHeadAttention(nn.Module):
 
     num_heads: int
     attention_fn: AttentionFn = default_attention
+    dtype: t.Any = jnp.float32  # projection compute dtype; params stay f32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        dtype = self.dtype
         b, s, d_model = x.shape
         assert d_model % self.num_heads == 0, (d_model, self.num_heads)
         head_dim = d_model // self.num_heads
@@ -97,12 +99,14 @@ class MultiHeadAttention(nn.Module):
         # Megatron attention pairing: q/k/v projections column-parallel
         # (equivalently: heads sharded over tp), output projection
         # row-parallel — one psum per attention block under tp.
-        q = split(Dense(d_model, tp_role="col")(x))
-        k = split(Dense(d_model, tp_role="col")(x))
-        v = split(Dense(d_model, tp_role="col")(x))
+        # The attention kernels accumulate in f32 regardless of input
+        # dtype (see ops/attention.py), so bf16 q/k/v is safe.
+        q = split(Dense(d_model, tp_role="col", dtype=dtype)(x))
+        k = split(Dense(d_model, tp_role="col", dtype=dtype)(x))
+        v = split(Dense(d_model, tp_role="col", dtype=dtype)(x))
         out = self.attention_fn(q, k, v, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, d_model)
-        return Dense(d_model, tp_role="row")(out)
+        return Dense(d_model, tp_role="row", dtype=dtype)(out)
 
 
 class TransformerBlock(nn.Module):
@@ -111,17 +115,21 @@ class TransformerBlock(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     attention_fn: AttentionFn = default_attention
+    dtype: t.Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        dtype = self.dtype
         d_model = x.shape[-1]
-        x = x + MultiHeadAttention(self.num_heads, self.attention_fn)(
-            nn.LayerNorm()(x)
-        )
+        # LayerNorm statistics stay float32 (flax upcasts internally);
+        # its output is cast to the compute dtype by the next Dense.
+        x = x + MultiHeadAttention(
+            self.num_heads, self.attention_fn, dtype=dtype
+        )(nn.LayerNorm()(x))
         h = nn.LayerNorm()(x)
-        h = Dense(self.mlp_ratio * d_model, tp_role="col")(h)
+        h = Dense(self.mlp_ratio * d_model, tp_role="col", dtype=dtype)(h)
         h = nn.gelu(h)
-        h = Dense(d_model, tp_role="row")(h)
+        h = Dense(d_model, tp_role="row", dtype=dtype)(h)
         return x + h
 
 
@@ -139,9 +147,11 @@ class SequenceTrunk(nn.Module):
     num_layers: int = 2
     max_len: int = 512
     attention_fn: AttentionFn = default_attention
+    dtype: t.Any = jnp.float32
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, pos_offset: jax.Array | int = 0):
+        dtype = self.dtype
         b, s, _ = obs_seq.shape
         # jnp.take clamps out-of-bounds rows silently — aliased positions
         # would train without error, so reject oversized histories here.
@@ -150,16 +160,20 @@ class SequenceTrunk(nn.Module):
         assert s <= self.max_len, (
             f"history length {s} exceeds max_len={self.max_len}"
         )
-        x = Dense(self.d_model)(obs_seq)
+        x = Dense(self.d_model, dtype=dtype)(obs_seq)
         pos_table = self.param(
             "pos_embedding",
             nn.initializers.normal(0.02),
             (self.max_len, self.d_model),
         )
         pos = pos_offset + jnp.arange(s)
-        x = x + jnp.take(pos_table, pos, axis=0)[None]
+        # The f32 pos table would promote a bf16 residual stream back to
+        # f32; cast the sum to the compute dtype explicitly.
+        x = (x + jnp.take(pos_table, pos, axis=0)[None]).astype(dtype)
         for _ in range(self.num_layers):
-            x = TransformerBlock(self.num_heads, attention_fn=self.attention_fn)(x)
+            x = TransformerBlock(
+                self.num_heads, attention_fn=self.attention_fn, dtype=dtype
+            )(x)
         return nn.LayerNorm()(x)
 
 
@@ -187,14 +201,15 @@ class SequenceActor(nn.Module):
     # params: the tree layout (and checkpoints) are unchanged.
     sp_axis: str | None = None
     sp_size: int = 1
+    dtype: t.Any = jnp.float32  # see Actor.dtype; distribution math stays f32
 
     def setup(self):
         self._trunk = SequenceTrunk(
             self.d_model, self.num_heads, self.num_layers, self.max_len,
-            self.attention_fn,
+            self.attention_fn, dtype=self.dtype,
         )
-        self._mu = Dense(self.act_dim)
-        self._log_std = Dense(self.act_dim)
+        self._mu = Dense(self.act_dim, dtype=self.dtype)
+        self._log_std = Dense(self.act_dim, dtype=self.dtype)
 
     def trunk(self, obs_seq: jax.Array, pos_offset: jax.Array | int = 0):
         return self._trunk(obs_seq, pos_offset)
@@ -206,8 +221,8 @@ class SequenceActor(nn.Module):
         deterministic: bool = False,
         with_logprob: bool = True,
     ):
-        mu = self._mu(h)
-        log_std = self._log_std(h)
+        mu = self._mu(h).astype(jnp.float32)
+        log_std = self._log_std(h).astype(jnp.float32)
         return squashed_gaussian_sample(
             key, mu, log_std, self.act_limit, deterministic, with_logprob
         )
@@ -246,19 +261,21 @@ class SequenceCritic(nn.Module):
     attention_fn: AttentionFn = default_attention
     sp_axis: str | None = None  # see SequenceActor.sp_axis
     sp_size: int = 1
+    dtype: t.Any = jnp.float32  # see Critic.dtype; Q cast back to float32
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, action: jax.Array) -> jax.Array:
+        dtype = self.dtype
         unbatched, obs_seq, action = _auto_batch(obs_seq, action)
         h_all = SequenceTrunk(
             self.d_model, self.num_heads, self.num_layers, self.max_len,
-            self.attention_fn,
+            self.attention_fn, dtype=dtype,
         )(obs_seq, _sp_pos_offset(obs_seq, self.sp_axis))
         h = _sp_last_token(h_all, self.sp_axis, self.sp_size)
-        x = jnp.concatenate([h, action], axis=-1)
-        x = nn.relu(Dense(self.hidden)(x))
-        x = Dense(1)(x)
-        q = jnp.squeeze(x, axis=-1)
+        x = jnp.concatenate([h, action.astype(h.dtype)], axis=-1)
+        x = nn.relu(Dense(self.hidden, dtype=dtype)(x))
+        x = Dense(1, dtype=dtype)(x)
+        q = jnp.squeeze(x.astype(jnp.float32), axis=-1)
         return jnp.squeeze(q, 0) if unbatched else q
 
 
@@ -276,6 +293,7 @@ class SequenceDoubleCritic(nn.Module):
     attention_fn: AttentionFn = default_attention
     sp_axis: str | None = None  # see SequenceActor.sp_axis
     sp_size: int = 1
+    dtype: t.Any = jnp.float32
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, action: jax.Array) -> jax.Array:
@@ -290,5 +308,6 @@ class SequenceDoubleCritic(nn.Module):
         return ensemble(
             self.d_model, self.num_heads, self.num_layers, self.max_len,
             self.hidden, self.attention_fn, self.sp_axis, self.sp_size,
+            dtype=self.dtype,
             name="ensemble",
         )(obs_seq, action)

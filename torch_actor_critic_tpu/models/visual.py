@@ -81,9 +81,11 @@ class SimpleCNN(nn.Module):
     dense_size: int = 512
     out_features: int = 1  # the reference's scalar-vision bottleneck
     normalize_pixels: bool = False
+    dtype: t.Any = jnp.float32  # conv/dense compute dtype; params stay f32
 
     @nn.compact
     def __call__(self, frame: jax.Array) -> jax.Array:
+        dtype = self.dtype
         x = frame.astype(jnp.float32)
         if self.normalize_pixels:
             x = x / 255.0
@@ -98,6 +100,8 @@ class SimpleCNN(nn.Module):
                 padding="VALID",
                 kernel_init=torch_linear_kernel_init,
                 bias_init=torch_linear_bias_init(fan_in),
+                dtype=dtype,
+                param_dtype=jnp.float32,
                 name=f"conv_{i}",
             )(x)
             if 0 in x.shape[-3:]:
@@ -112,8 +116,8 @@ class SimpleCNN(nn.Module):
         x = x.reshape(x.shape[:-3] + (-1,))
         # Megatron pair over tp: the wide flatten->dense is
         # column-parallel, the projection to out_features row-parallel.
-        x = Dense(self.dense_size, tp_role="col")(x)
-        x = Dense(self.out_features, tp_role="row")(x)
+        x = Dense(self.dense_size, tp_role="col", dtype=dtype)(x)
+        x = Dense(self.out_features, tp_role="row", dtype=dtype)(x)
         return x
 
 
@@ -135,6 +139,7 @@ class VisualActor(nn.Module):
     strides: t.Sequence[int] = (4, 2, 1)
     cnn_features: int = 1
     normalize_pixels: bool = False
+    dtype: t.Any = jnp.float32  # see Actor.dtype: matmuls only, heads cast f32
 
     @nn.compact
     def __call__(
@@ -144,6 +149,7 @@ class VisualActor(nn.Module):
         deterministic: bool = False,
         with_logprob: bool = True,
     ):
+        dtype = self.dtype
         features, frame = obs.features, obs.frame
         unbatched = features.ndim == 1
         if unbatched:
@@ -151,19 +157,20 @@ class VisualActor(nn.Module):
         if frame.ndim == 3:
             frame = frame[None]
 
-        x = MLP(self.hidden_sizes, activate_final=True)(features)
+        x = MLP(self.hidden_sizes, activate_final=True, dtype=dtype)(features)
         vision = SimpleCNN(
             self.filters,
             self.kernel_sizes,
             self.strides,
             out_features=self.cnn_features,
             normalize_pixels=self.normalize_pixels,
+            dtype=dtype,
             name="visual_network",
         )(frame)
-        x = jnp.concatenate([x, vision], axis=-1)
+        x = jnp.concatenate([x, vision.astype(x.dtype)], axis=-1)
 
-        mu = Dense(self.act_dim)(x)
-        log_std = Dense(self.act_dim)(x)
+        mu = Dense(self.act_dim, dtype=dtype)(x).astype(jnp.float32)
+        log_std = Dense(self.act_dim, dtype=dtype)(x).astype(jnp.float32)
         action, logprob = squashed_gaussian_sample(
             key, mu, log_std, self.act_limit, deterministic, with_logprob
         )
@@ -190,9 +197,11 @@ class VisualCritic(nn.Module):
     strides: t.Sequence[int] = (4, 2, 1)
     cnn_features: int = 1
     normalize_pixels: bool = False
+    dtype: t.Any = jnp.float32  # see Critic.dtype: Q cast back to float32
 
     @nn.compact
     def __call__(self, obs: MultiObservation, action: jax.Array) -> jax.Array:
+        dtype = self.dtype
         features, frame = obs.features, obs.frame
         unbatched = features.ndim == 1
         if unbatched:
@@ -204,18 +213,20 @@ class VisualCritic(nn.Module):
         x = jnp.concatenate([features, action], axis=-1)
         # ReLU after every layer, including the final width-1 layer
         # (reference behavior, convolutional.py:156-158).
-        x = MLP(tuple(self.hidden_sizes) + (1,), activate_final=True)(x)
+        x = MLP(tuple(self.hidden_sizes) + (1,), activate_final=True,
+                dtype=dtype)(x)
         vision = SimpleCNN(
             self.filters,
             self.kernel_sizes,
             self.strides,
             out_features=self.cnn_features,
             normalize_pixels=self.normalize_pixels,
+            dtype=dtype,
             name="visual_network",
         )(frame)
-        x = jnp.concatenate([x, vision], axis=-1)
-        q = Dense(1, name="final")(x)
-        q = jnp.squeeze(q, axis=-1)
+        x = jnp.concatenate([x, vision.astype(x.dtype)], axis=-1)
+        q = Dense(1, dtype=dtype, name="final")(x)
+        q = jnp.squeeze(q.astype(jnp.float32), axis=-1)
         if unbatched:
             q = jnp.squeeze(q, axis=0)
         return q
@@ -235,6 +246,7 @@ class VisualDoubleCritic(nn.Module):
     cnn_features: int = 1
     normalize_pixels: bool = False
     num_qs: int = 2
+    dtype: t.Any = jnp.float32
 
     @nn.compact
     def __call__(self, obs: MultiObservation, action: jax.Array) -> jax.Array:
@@ -253,5 +265,6 @@ class VisualDoubleCritic(nn.Module):
             self.strides,
             self.cnn_features,
             self.normalize_pixels,
+            dtype=self.dtype,
             name="ensemble",
         )(obs, action)

@@ -338,8 +338,13 @@ def train_on_device(
             act_dim=env_cls.act_dim,
             hidden_sizes=config.hidden_sizes,
             act_limit=env_cls.act_limit,
+            dtype=config.model_dtype,
         ),
-        DoubleCritic(hidden_sizes=config.hidden_sizes, num_qs=config.num_qs),
+        DoubleCritic(
+            hidden_sizes=config.hidden_sizes,
+            num_qs=config.num_qs,
+            dtype=config.model_dtype,
+        ),
         env_cls.act_dim,
     )
     loop = OnDeviceLoop(sac, env_cls, n_envs=config.on_device_envs, mesh=mesh)

@@ -61,6 +61,7 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
     """Model-family dispatch on observation structure — the typed
     replacement of the reference's env-name string dispatch
     (ref ``main.py:63-90``)."""
+    dtype = config.model_dtype
     if isinstance(env.obs_spec, MultiObservation):
         actor = VisualActor(
             act_dim=env.act_dim,
@@ -71,6 +72,7 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
             strides=config.strides,
             cnn_features=config.cnn_features,
             normalize_pixels=config.normalize_pixels,
+            dtype=dtype,
         )
         critic = VisualDoubleCritic(
             hidden_sizes=config.hidden_sizes,
@@ -80,6 +82,7 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
             cnn_features=config.cnn_features,
             normalize_pixels=config.normalize_pixels,
             num_qs=config.num_qs,
+            dtype=dtype,
         )
     elif len(env.obs_spec.shape) == 2:
         # (history, obs_dim) observations from HistoryEnv → the
@@ -97,6 +100,7 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
             num_layers=config.seq_num_layers,
             max_len=horizon,
             act_limit=env.act_limit,
+            dtype=dtype,
         )
         critic = SequenceDoubleCritic(
             d_model=config.seq_d_model,
@@ -104,14 +108,18 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
             num_layers=config.seq_num_layers,
             max_len=horizon,
             num_qs=config.num_qs,
+            dtype=dtype,
         )
     else:
         actor = Actor(
             act_dim=env.act_dim,
             hidden_sizes=config.hidden_sizes,
             act_limit=env.act_limit,
+            dtype=dtype,
         )
-        critic = DoubleCritic(hidden_sizes=config.hidden_sizes, num_qs=config.num_qs)
+        critic = DoubleCritic(
+            hidden_sizes=config.hidden_sizes, num_qs=config.num_qs, dtype=dtype
+        )
     return actor, critic
 
 

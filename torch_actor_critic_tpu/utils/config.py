@@ -85,6 +85,13 @@ class SACConfig:
     # usable option).
     normalize_observations: bool = False
 
+    # Network compute dtype: "float32" (parity default) or "bfloat16"
+    # (the MXU's native input width — matmuls/convs run bf16 while
+    # params, optimizer state, targets and all loss/distribution math
+    # stay float32, so checkpoints are precision-independent). The
+    # torch reference has no mixed-precision path at all.
+    compute_dtype: str = "float32"
+
     # Actor/learner split: run env-loop action selection on the host
     # CPU backend against a param mirror refreshed per update window,
     # instead of a per-step accelerator round trip.
@@ -112,6 +119,18 @@ class SACConfig:
                 "filters/kernel_sizes/strides must have equal length, got "
                 f"{len(self.filters)}/{len(self.kernel_sizes)}/{len(self.strides)}"
             )
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"compute_dtype must be 'float32' or 'bfloat16', got "
+                f"{self.compute_dtype!r}"
+            )
+
+    @property
+    def model_dtype(self):
+        """The jnp dtype models compute in (params always float32)."""
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.compute_dtype == "bfloat16" else jnp.float32
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)
