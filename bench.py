@@ -292,12 +292,12 @@ def bench_on_device(budget_s=300.0):
     return out
 
 
-def bench_attention(budget_s=180.0):
+def bench_attention(budget_s=180.0, t=2048):
     """Flash-attention kernel throughput (the long-context extension's
     hot op): causal fwd and fwd+bwd at a long-context shape, reported
     as achieved TFLOP/s. On TPU this exercises the Pallas kernels both
     directions (auto dispatch); elsewhere the XLA blockwise path."""
-    b, h, t, d = 4, 8, 2048, 64
+    b, h, d = 4, 8, 64
     out = {"shape": [b, h, t, d]}
     t_start = time.time()
     try:
@@ -476,7 +476,12 @@ _STAGES = {
     "headline_bf16": _stage_headline_bf16,
     "sweep": lambda: {"sweep": bench_sweep()},
     "on_device": lambda: {"on_device": bench_on_device()},
-    "attention": lambda: {"attention": bench_attention()},
+    # Two sequence lengths: the O(block)-memory kernel's scaling story —
+    # 4x the length = 16x the FLOPs at flat VMEM residency.
+    "attention": lambda: {
+        "attention": bench_attention(t=2048),
+        "attention_8k": bench_attention(t=8192),
+    },
 }
 
 
@@ -585,7 +590,9 @@ def main():
         # only that section's data, and each timeout covers its own
         # internal budget plus a fresh backend-init + compile.
         for stage, timeout_s in (
-            ("sweep", 420), ("on_device", 540), ("attention", 360)
+            # attention runs two lengths with 180s internal budgets
+            # each; its timeout covers both plus init + compiles.
+            ("sweep", 420), ("on_device", 540), ("attention", 600)
         ):
             res = run_stage_subprocess(
                 stage, timeout_s, diagnostics, platform=info.get("platform")

@@ -108,3 +108,25 @@ def test_visual_buffer_uint8_roundtrip():
     assert batch.states.frame.dtype == jnp.uint8
     assert int(batch.states.frame[0, 0, 0, 0]) == 200
     assert batch.states.features.shape == (8, 3)
+
+
+def test_estimate_buffer_bytes():
+    """Planning estimate behind the trainer's HBM-budget warning."""
+    import jax
+    import jax.numpy as jnp
+
+    from torch_actor_critic_tpu.buffer.replay import estimate_buffer_bytes
+    from torch_actor_critic_tpu.core.types import MultiObservation
+
+    flat = jax.ShapeDtypeStruct((17,), jnp.float32)
+    # 2*17*4 (obs+next) + 6*4 (act) + 8 (reward+done) = 168 B/row
+    assert estimate_buffer_bytes(1000, flat, 6) == 168_000
+
+    vis = MultiObservation(
+        features=jax.ShapeDtypeStruct((168,), jnp.float32),
+        frame=jax.ShapeDtypeStruct((64, 64, 3), jnp.uint8),
+    )
+    per_row = 2 * (168 * 4 + 64 * 64 * 3) + 56 * 4 + 8
+    assert estimate_buffer_bytes(10, vis, 56) == 10 * per_row
+    # The motivating case: 1e6 visual transitions ~ 26 GB > any v5e.
+    assert estimate_buffer_bytes(1_000_000, vis, 56) > 16 * 1024**3

@@ -42,6 +42,7 @@ import typing as t
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torch_actor_critic_tpu.core.types import Batch, BufferState, MultiObservation
 
@@ -55,6 +56,23 @@ def _zeros_like_spec(capacity: int, spec: t.Any) -> t.Any:
     return jax.tree_util.tree_map(
         lambda s: jnp.zeros((capacity,) + tuple(s.shape), s.dtype), spec
     )
+
+
+def estimate_buffer_bytes(capacity: int, obs_spec: t.Any, act_dim: int) -> int:
+    """HBM bytes one replay shard of ``capacity`` transitions occupies.
+
+    Two observation copies (state, next_state) + action + reward + done
+    per row — the planning number behind the trainer's HBM-budget
+    warning (1e6 visual transitions at the wall-runner geometry come to
+    ~26 GB — two uint8 frame copies plus features per row — which no
+    single v5e's 16 GB can hold).
+    """
+    obs_bytes = sum(
+        int(np.prod(s.shape)) * jnp.dtype(s.dtype).itemsize
+        for s in jax.tree_util.tree_leaves(obs_spec)
+    )
+    row = 2 * obs_bytes + act_dim * 4 + 2 * 4
+    return capacity * row
 
 
 def init_replay_buffer(

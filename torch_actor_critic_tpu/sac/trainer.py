@@ -284,11 +284,44 @@ class Trainer:
         # count): total replay capacity is buffer_size regardless of how
         # many hosts the slices are spread over.
         per_dev_capacity = max(self.config.buffer_size // self.mesh.shape["dp"], 1)
+        self._warn_if_buffer_exceeds_hbm(per_dev_capacity)
         self.buffer = init_sharded_buffer(
             per_dev_capacity, self.pool.obs_spec, self.pool.act_dim, self.mesh,
             sp=self.dp.effective_sp,
         )
         self.start_epoch = 0
+
+    def _warn_if_buffer_exceeds_hbm(self, per_dev_capacity: int) -> None:
+        """Flag replay shards that will crowd out update intermediates.
+
+        The HBM-resident buffer is the design's core trade (zero
+        host<->device replay traffic), so an oversized capacity fails as
+        an opaque allocator OOM mid-run; estimate up front instead. The
+        reference never hits this: its buffer lives in host RAM
+        (ref ``buffer/replay_buffer.py``).
+        """
+        from torch_actor_critic_tpu.buffer.replay import estimate_buffer_bytes
+
+        dev = jax.local_devices()[0]
+        if dev.platform == "cpu":
+            return
+        stats = getattr(dev, "memory_stats", lambda: None)() or {}
+        hbm = stats.get("bytes_limit", 16 * 1024**3)
+        need = estimate_buffer_bytes(
+            per_dev_capacity, self.pool.obs_spec, self.pool.act_dim
+        )
+        # Sequence-history leaves additionally shard their T axis over
+        # sp (init_sharded_buffer), dividing residency across the ring;
+        # the non-observation fields this over-discounts are noise.
+        need //= max(self.dp.effective_sp, 1)
+        if need > 0.5 * hbm:
+            logger.warning(
+                "replay shard needs ~%.1f GB of ~%.1f GB device memory; "
+                "params, optimizer state and update intermediates share "
+                "the rest — reduce --buffer-size (or raise dp) if "
+                "allocation fails",
+                need / 1024**3, hbm / 1024**3,
+            )
 
     # ------------------------------------------------------------ helpers
 
