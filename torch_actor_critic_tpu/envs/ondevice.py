@@ -369,3 +369,68 @@ def get_on_device_env(name: str):
             name,
         )
     return env
+
+
+def history_env(base_cls, horizon: int):
+    """Sliding-window history adapter over an on-device env class — the
+    fused-loop twin of the host ``HistoryEnv`` wrapper
+    (``envs/wrappers.py:158``), enabling sequence policies
+    (``models/sequence.py``) to train entirely on-chip.
+
+    Same semantics as the host wrapper: observations become
+    ``(horizon, D)`` windows, newest frame last; on (auto-)reset the
+    window is filled with the initial observation — no zero-state
+    transient. The rolling buffer lives in ``EnvState.obs``, so the
+    adapter composes with the vmapped/dp-sharded loop unchanged; the
+    base env's physics state rides in ``EnvState.inner``.
+    """
+    horizon = int(horizon)
+    if horizon < 2:
+        raise ValueError(f"history_env needs horizon >= 2, got {horizon}")
+
+    class HistoryJax:
+        obs_dim = base_cls.obs_dim  # per-timestep feature width
+        obs_shape = (horizon, base_cls.obs_dim)
+        act_dim = base_cls.act_dim
+        act_limit = base_cls.act_limit
+        max_episode_steps = base_cls.max_episode_steps
+
+        @classmethod
+        def _fill(cls, obs):
+            return jnp.tile(obs[None], (horizon,) + (1,) * obs.ndim)
+
+        @classmethod
+        def reset(cls, key: jax.Array) -> EnvState:
+            s = base_cls.reset(key)
+            return EnvState(
+                inner=s,
+                obs=cls._fill(s.obs),
+                step_count=s.step_count,
+                episode_return=s.episode_return,
+                rng=s.rng,
+            )
+
+        @classmethod
+        def step(cls, state: EnvState, action: jax.Array):
+            s_next, out = base_cls.step(state.inner, action)
+            # The buffer's next_state: the pre-reset window (newest
+            # frame = the base env's pre-reset next obs).
+            pushed = jnp.concatenate(
+                [state.obs[1:], out.next_obs[None]], axis=0
+            )
+            # Post-step window: refilled from the fresh obs when the
+            # episode ended (base envs auto-reset), rolled otherwise
+            # (s_next.obs == out.next_obs in that case).
+            window = jnp.where(out.ended, cls._fill(s_next.obs), pushed)
+            next_state = EnvState(
+                inner=s_next,
+                obs=window,
+                step_count=s_next.step_count,
+                episode_return=s_next.episode_return,
+                rng=s_next.rng,
+            )
+            return next_state, out.replace(next_obs=pushed)
+
+    HistoryJax.__name__ = f"History{horizon}x{base_cls.__name__}"
+    HistoryJax.__qualname__ = HistoryJax.__name__
+    return HistoryJax

@@ -19,6 +19,7 @@ the same :meth:`SAC.update_burst` the host trainer dispatches.
 
 from __future__ import annotations
 
+import logging
 import typing as t
 
 import jax
@@ -73,14 +74,32 @@ class OnDeviceLoop:
         """``buffer_capacity`` is per dp slice, matching the reference's
         per-worker buffers (ref ``main.py:140-141``)."""
         k_state, k_envs, k_act = jax.random.split(key, 3)
-        train_state = self.sac.init_state(
-            k_state, jnp.zeros((self.env.obs_dim,))
-        )
-        buffer = init_replay_buffer(
-            buffer_capacity,
-            jax.ShapeDtypeStruct((self.env.obs_dim,), jnp.float32),
-            self.env.act_dim,
-        )
+        # (horizon, D) for history-wrapped envs, (D,) for flat ones.
+        obs_shape = getattr(self.env, "obs_shape", (self.env.obs_dim,))
+        obs_spec = jax.ShapeDtypeStruct(obs_shape, jnp.float32)
+        # Same HBM-budget check as the host trainer: history windows
+        # multiply the resident shard by horizon, and the fused loop
+        # fails as an opaque allocator OOM otherwise.
+        dev = jax.local_devices()[0]
+        if dev.platform != "cpu":
+            from torch_actor_critic_tpu.buffer.replay import (
+                estimate_buffer_bytes,
+            )
+
+            stats = getattr(dev, "memory_stats", lambda: None)() or {}
+            hbm = stats.get("bytes_limit", 16 * 1024**3)
+            need = estimate_buffer_bytes(
+                buffer_capacity, obs_spec, self.env.act_dim
+            )
+            if need > 0.5 * hbm:
+                logging.getLogger(__name__).warning(
+                    "on-device replay shard needs ~%.1f GB of ~%.1f GB "
+                    "device memory; reduce buffer_capacity (or "
+                    "history_len) if allocation fails",
+                    need / 1024**3, hbm / 1024**3,
+                )
+        train_state = self.sac.init_state(k_state, jnp.zeros(obs_shape))
+        buffer = init_replay_buffer(buffer_capacity, obs_spec, self.env.act_dim)
         if self.mesh is None:
             env_states = jax.vmap(self.env.reset)(
                 jax.random.split(k_envs, self.n_envs)
@@ -300,6 +319,18 @@ class OnDeviceLoop:
         return self._epoch_fns[sig](train_state, buffer, env_states, act_key)
 
 
+class _SpecView:
+    """The env-protocol triple ``build_models`` dispatches on, derived
+    from an on-device env class (which carries shapes as class attrs)."""
+
+    def __init__(self, env_cls):
+        self.obs_spec = jax.ShapeDtypeStruct(
+            getattr(env_cls, "obs_shape", (env_cls.obs_dim,)), jnp.float32
+        )
+        self.act_dim = env_cls.act_dim
+        self.act_limit = env_cls.act_limit
+
+
 def train_on_device(
     env_name: str,
     config,
@@ -323,7 +354,6 @@ def train_on_device(
         ON_DEVICE_ENVS,
         get_on_device_env,
     )
-    from torch_actor_critic_tpu.models import Actor, DoubleCritic
     from torch_actor_critic_tpu.parallel.distributed import is_coordinator
 
     env_cls = get_on_device_env(env_name)
@@ -332,21 +362,19 @@ def train_on_device(
             f"{env_name!r} has no pure-JAX twin; on-device training "
             f"supports {sorted(ON_DEVICE_ENVS)}"
         )
-    sac = SAC(
-        config,
-        Actor(
-            act_dim=env_cls.act_dim,
-            hidden_sizes=config.hidden_sizes,
-            act_limit=env_cls.act_limit,
-            dtype=config.model_dtype,
-        ),
-        DoubleCritic(
-            hidden_sizes=config.hidden_sizes,
-            num_qs=config.num_qs,
-            dtype=config.model_dtype,
-        ),
-        env_cls.act_dim,
-    )
+    if config.history_len > 1:
+        # Long-context on-device: window the env (fused HistoryEnv twin)
+        # and train the causal-transformer stack entirely on-chip.
+        from torch_actor_critic_tpu.envs.ondevice import history_env
+
+        env_cls = history_env(env_cls, config.history_len)
+    # One model-construction dispatch for host and fused paths
+    # (trainer.build_models keys on observation structure), so the two
+    # paths can never train differently-shaped models for one config.
+    from torch_actor_critic_tpu.sac.trainer import build_models
+
+    actor, critic = build_models(config, _SpecView(env_cls))
+    sac = SAC(config, actor, critic, env_cls.act_dim)
     loop = OnDeviceLoop(sac, env_cls, n_envs=config.on_device_envs, mesh=mesh)
     state, buffer, env_states, act_key = loop.init(
         jax.random.key(seed), buffer_capacity=config.buffer_size
