@@ -278,15 +278,24 @@ def bench_on_device(budget_s=300.0):
         return {"error": "benchmark_on_device not available"}
     # n_envs=16 matches earlier rounds; the 128-env point shows the
     # fused loop's near-free env scaling (vectorized physics shares the
-    # dispatch + update cost) — a shape the host-loop reference cannot
-    # express at all.
-    for env_name, n_envs in (("pendulum", 16), ("cheetah", 16), ("cheetah", 128)):
-        key = env_name if n_envs == 16 else f"{env_name}@{n_envs}"
+    # dispatch + update cost); the history-8 point times the fused
+    # long-context (causal-transformer) path — shapes the host-loop
+    # reference cannot express at all.
+    for env_name, n_envs, hist in (
+        ("pendulum", 16, 1),
+        ("cheetah", 16, 1),
+        ("cheetah", 128, 1),
+        ("cheetah", 16, 8),
+    ):
+        key = env_name + ("" if n_envs == 16 else f"@{n_envs}")
+        key += "" if hist == 1 else f"_h{hist}"
         if time.time() - t_start > budget_s:
             out[key] = {"error": "budget exhausted"}
             continue
         try:
-            out[key] = benchmark_on_device(env_name, n_envs=n_envs)
+            out[key] = benchmark_on_device(
+                env_name, n_envs=n_envs, history_len=hist
+            )
         except Exception as e:  # noqa: BLE001
             out[key] = {"error": repr(e)}
     return out

@@ -331,6 +331,23 @@ class _SpecView:
         self.act_limit = env_cls.act_limit
 
 
+def _wrap_and_build(env_cls, config) -> t.Tuple[t.Any, SAC]:
+    """History-wrap the env class per config and build its SAC.
+
+    The ONE construction path for both training (``train_on_device``)
+    and benchmarking (``benchmark_on_device``), sharing
+    ``trainer.build_models`` with the host loop — the bench can never
+    time a differently-built model than training uses.
+    """
+    from torch_actor_critic_tpu.envs.ondevice import history_env
+    from torch_actor_critic_tpu.sac.trainer import build_models
+
+    if config.history_len > 1:
+        env_cls = history_env(env_cls, config.history_len)
+    actor, critic = build_models(config, _SpecView(env_cls))
+    return env_cls, SAC(config, actor, critic, env_cls.act_dim)
+
+
 def train_on_device(
     env_name: str,
     config,
@@ -362,19 +379,9 @@ def train_on_device(
             f"{env_name!r} has no pure-JAX twin; on-device training "
             f"supports {sorted(ON_DEVICE_ENVS)}"
         )
-    if config.history_len > 1:
-        # Long-context on-device: window the env (fused HistoryEnv twin)
-        # and train the causal-transformer stack entirely on-chip.
-        from torch_actor_critic_tpu.envs.ondevice import history_env
-
-        env_cls = history_env(env_cls, config.history_len)
-    # One model-construction dispatch for host and fused paths
-    # (trainer.build_models keys on observation structure), so the two
-    # paths can never train differently-shaped models for one config.
-    from torch_actor_critic_tpu.sac.trainer import build_models
-
-    actor, critic = build_models(config, _SpecView(env_cls))
-    sac = SAC(config, actor, critic, env_cls.act_dim)
+    # history_len > 1 windows the env on-chip (fused HistoryEnv twin)
+    # and dispatches to the causal-transformer stack via build_models.
+    env_cls, sac = _wrap_and_build(env_cls, config)
     loop = OnDeviceLoop(sac, env_cls, n_envs=config.on_device_envs, mesh=mesh)
     state, buffer, env_states, act_key = loop.init(
         jax.random.key(seed), buffer_capacity=config.buffer_size
@@ -432,33 +439,29 @@ def train_on_device(
 
 
 def benchmark_on_device(
-    env_name: str, steps: int = 500, n_envs: int = 16, update_every: int = 50
+    env_name: str, steps: int = 500, n_envs: int = 16, update_every: int = 50,
+    history_len: int = 1,
 ) -> dict:
     """Timed fused-loop epoch at the headline model config (hidden
     [256,256], batch 64 — BASELINE.md); returns env/grad steps per sec
     for ``bench.py``'s ``on_device`` section. Short names accepted
-    ("pendulum", "cheetah")."""
+    ("pendulum", "cheetah"). ``history_len > 1`` windows the env and
+    times the causal-transformer (sequence) stack instead — the fused
+    long-context path.
+    """
     import time
 
     from torch_actor_critic_tpu.envs.ondevice import get_on_device_env
-    from torch_actor_critic_tpu.models import Actor, DoubleCritic
     from torch_actor_critic_tpu.utils.config import SACConfig
 
     aliases = {"pendulum": "Pendulum-v1", "cheetah": "cheetah-run-jax"}
     env_cls = get_on_device_env(aliases.get(env_name, env_name))
     if env_cls is None:
         raise ValueError(f"no on-device twin for {env_name!r}")
-    cfg = SACConfig(hidden_sizes=(256, 256), batch_size=64)
-    sac = SAC(
-        cfg,
-        Actor(
-            act_dim=env_cls.act_dim,
-            hidden_sizes=cfg.hidden_sizes,
-            act_limit=env_cls.act_limit,
-        ),
-        DoubleCritic(hidden_sizes=cfg.hidden_sizes),
-        env_cls.act_dim,
+    cfg = SACConfig(
+        hidden_sizes=(256, 256), batch_size=64, history_len=history_len
     )
+    env_cls, sac = _wrap_and_build(env_cls, cfg)
     loop = OnDeviceLoop(sac, env_cls, n_envs=n_envs)
     ts, buf, es, key = loop.init(jax.random.key(0), buffer_capacity=200_000)
     ts, buf, es, key, _ = loop.epoch(
@@ -476,9 +479,12 @@ def benchmark_on_device(
     )
     drain(m["loss_q"])
     dt = time.perf_counter() - t0
-    return {
+    out = {
         "env": aliases.get(env_name, env_name),
         "n_envs": n_envs,
         "env_steps_per_sec": round(steps * n_envs / dt, 1),
         "grad_steps_per_sec": round(steps / dt, 1),
     }
+    if history_len > 1:
+        out["history_len"] = history_len
+    return out
