@@ -177,3 +177,26 @@ def test_update_burst_end_to_end(sac_and_state):
     assert int(buf2.size) == 42
     assert np.isfinite(float(metrics["loss_q"]))
     assert metrics["loss_q"].shape == ()  # averaged over the burst
+
+
+def test_redq_wide_ensemble_updates():
+    """num_qs=4 (REDQ-style): the vmapped ensemble generalizes past the
+    reference's hardwired twin — wider min-clipping targets train with
+    finite losses and a (4, B) Q surface."""
+    sac = make_sac(num_qs=4)
+    state = sac.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
+    batch = make_batch(jax.random.key(1))
+    q = sac.critic_def.apply(state.critic_params, batch.states, batch.actions)
+    assert q.shape == (4, 8)
+    new_state, metrics = jax.jit(sac.update)(state, batch)
+    assert np.isfinite(float(metrics["loss_q"]))
+    assert np.isfinite(float(metrics["loss_pi"]))
+    # All four members moved.
+    for i in range(4):
+        a = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda x: x[i], state.critic_params)
+        )[0]
+        b = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda x: x[i], new_state.critic_params)
+        )[0]
+        assert not np.allclose(np.asarray(a), np.asarray(b))
