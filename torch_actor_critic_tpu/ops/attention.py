@@ -305,6 +305,19 @@ def _flash_forward(
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if not interpret and jax.default_backend() != "tpu":
+        # Without this, a compiled Pallas call on a CPU/GPU process dies
+        # much later in lowering with a cryptic Mosaic error (the
+        # trace-time 'auto' dispatch footgun, see attention()'s CAUTION
+        # note). Trace-time default_backend is the right check: the
+        # kernel choice is also made at trace time.
+        raise RuntimeError(
+            "flash_attention compiles Pallas TPU kernels but this "
+            f"process's default backend is {jax.default_backend()!r}; "
+            "use attention(..., impl='xla') (or inject "
+            "models.sequence.xla_attention into sequence models), or "
+            "pass interpret=True for CPU testing."
+        )
     b, h, tq, d = q.shape
     tk = k.shape[2]
     block_q, block_k = _check_blocks(tq, tk, block_q, block_k)
@@ -628,6 +641,9 @@ def attention(
     ``models.sequence.xla_attention``. (``lax.platform_dependent`` is
     not an option: XLA still lowers the dead Pallas branch on CPU and
     ``pallas_call`` has no CPU lowering outside interpret mode.)
+    Tracing the Pallas path on a non-TPU-default process raises a clear
+    ``RuntimeError`` at trace time (tests/test_attention.py pins this)
+    instead of a cryptic Mosaic lowering error.
     """
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"

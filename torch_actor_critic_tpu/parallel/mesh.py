@@ -67,7 +67,19 @@ def local_dp_info(mesh: Mesh) -> t.Tuple[int, int]:
                 "the mesh so each dp slice (its tp*sp block) is owned by "
                 "one process (tp*sp must divide the local device count)."
             )
-    offset = mine[0] if mine else 0
+    if not mine:
+        # A process with zero dp slices would build a 0-env pool and
+        # fail obscurely at reset_all; there is no learner-only role in
+        # the host loop (every process pairs its envs with the replay
+        # shards it can address), so reject the topology up front.
+        raise ValueError(
+            f"process {pi} owns no complete dp slice of mesh "
+            f"{dict(mesh.shape)}: with {jax.process_count()} processes, "
+            "tp*sp must not exceed the local device count and dp must "
+            "be >= the process count so every process gets at least one "
+            "slice (e.g. lower tp/sp or raise dp in make_mesh)."
+        )
+    offset = mine[0]
     if mine != list(range(offset, offset + len(mine))):
         # Non-contiguous ownership would silently mis-attribute chunk
         # rows to the wrong global slices (and duplicate env seeds).

@@ -40,6 +40,12 @@ def parse_arguments(argv=None) -> argparse.Namespace:
     parser.add_argument(
         "--random", action="store_false", dest="deterministic", help="Stochastic policy"
     )
+    parser.add_argument(
+        "--seed", type=int, default=None,
+        help="Seed episode resets (episode i uses seed+i) and the acting "
+        "PRNG; two invocations with the same seed produce identical "
+        "returns",
+    )
     parser.set_defaults(render=True, deterministic=True)
     return parser.parse_args(argv)
 
@@ -73,6 +79,7 @@ def main(argv=None):
             episodes=args.episodes,
             deterministic=args.deterministic,
             render=args.render,
+            seed=args.seed,
         )
     finally:
         trainer.close()

@@ -19,7 +19,6 @@ the same :meth:`SAC.update_burst` the host trainer dispatches.
 
 from __future__ import annotations
 
-import logging
 import typing as t
 
 import jax
@@ -77,27 +76,18 @@ class OnDeviceLoop:
         # (horizon, D) for history-wrapped envs, (D,) for flat ones.
         obs_shape = getattr(self.env, "obs_shape", (self.env.obs_dim,))
         obs_spec = jax.ShapeDtypeStruct(obs_shape, jnp.float32)
-        # Same HBM-budget check as the host trainer: history windows
+        # Same HBM-budget check as the host trainer (shared helper so
+        # the two loops' thresholds cannot drift): history windows
         # multiply the resident shard by horizon, and the fused loop
         # fails as an opaque allocator OOM otherwise.
-        dev = jax.local_devices()[0]
-        if dev.platform != "cpu":
-            from torch_actor_critic_tpu.buffer.replay import (
-                estimate_buffer_bytes,
-            )
+        from torch_actor_critic_tpu.buffer.replay import (
+            warn_if_buffer_exceeds_hbm,
+        )
 
-            stats = getattr(dev, "memory_stats", lambda: None)() or {}
-            hbm = stats.get("bytes_limit", 16 * 1024**3)
-            need = estimate_buffer_bytes(
-                buffer_capacity, obs_spec, self.env.act_dim
-            )
-            if need > 0.5 * hbm:
-                logging.getLogger(__name__).warning(
-                    "on-device replay shard needs ~%.1f GB of ~%.1f GB "
-                    "device memory; reduce buffer_capacity (or "
-                    "history_len) if allocation fails",
-                    need / 1024**3, hbm / 1024**3,
-                )
+        warn_if_buffer_exceeds_hbm(
+            buffer_capacity, obs_spec, self.env.act_dim,
+            advice="reduce buffer_capacity (or history_len)",
+        )
         train_state = self.sac.init_state(k_state, jnp.zeros(obs_shape))
         buffer = init_replay_buffer(buffer_capacity, obs_spec, self.env.act_dim)
         if self.mesh is None:
@@ -414,8 +404,11 @@ def train_on_device(
             steps=config.steps_per_epoch,
             update_every=config.update_every,
         )
-        # Host-fetch drain before reading the clock (see utils/sync.py:
+        # Host-fetch drain before reading the clock (utils/sync.py:
         # block_until_ready is not a true barrier on the axon backend).
+        # The float() fetches below would drain too, but the timing
+        # contract should not hinge on dict iteration order.
+        drain(m["loss_q"])
         metrics = {k: float(v) for k, v in m.items()}
         dt = time.time() - t0
         metrics["env_steps_per_sec"] = (

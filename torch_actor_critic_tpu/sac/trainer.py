@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torch_actor_critic_tpu.buffer.replay import warn_if_buffer_exceeds_hbm
 from torch_actor_critic_tpu.core.types import Batch, MultiObservation
 from torch_actor_critic_tpu.envs.vec_env import make_env_pool
 from torch_actor_critic_tpu.envs.wrappers import is_visual_env
@@ -284,44 +285,16 @@ class Trainer:
         # count): total replay capacity is buffer_size regardless of how
         # many hosts the slices are spread over.
         per_dev_capacity = max(self.config.buffer_size // self.mesh.shape["dp"], 1)
-        self._warn_if_buffer_exceeds_hbm(per_dev_capacity)
+        warn_if_buffer_exceeds_hbm(
+            per_dev_capacity, self.pool.obs_spec, self.pool.act_dim,
+            sp=self.dp.effective_sp,
+            advice="reduce --buffer-size (or raise dp)",
+        )
         self.buffer = init_sharded_buffer(
             per_dev_capacity, self.pool.obs_spec, self.pool.act_dim, self.mesh,
             sp=self.dp.effective_sp,
         )
         self.start_epoch = 0
-
-    def _warn_if_buffer_exceeds_hbm(self, per_dev_capacity: int) -> None:
-        """Flag replay shards that will crowd out update intermediates.
-
-        The HBM-resident buffer is the design's core trade (zero
-        host<->device replay traffic), so an oversized capacity fails as
-        an opaque allocator OOM mid-run; estimate up front instead. The
-        reference never hits this: its buffer lives in host RAM
-        (ref ``buffer/replay_buffer.py``).
-        """
-        from torch_actor_critic_tpu.buffer.replay import estimate_buffer_bytes
-
-        dev = jax.local_devices()[0]
-        if dev.platform == "cpu":
-            return
-        stats = getattr(dev, "memory_stats", lambda: None)() or {}
-        hbm = stats.get("bytes_limit", 16 * 1024**3)
-        need = estimate_buffer_bytes(
-            per_dev_capacity, self.pool.obs_spec, self.pool.act_dim
-        )
-        # Sequence-history leaves additionally shard their T axis over
-        # sp (init_sharded_buffer), dividing residency across the ring;
-        # the non-observation fields this over-discounts are noise.
-        need //= max(self.dp.effective_sp, 1)
-        if need > 0.5 * hbm:
-            logger.warning(
-                "replay shard needs ~%.1f GB of ~%.1f GB device memory; "
-                "params, optimizer state and update intermediates share "
-                "the rest — reduce --buffer-size (or raise dp) if "
-                "allocation fails",
-                need / 1024**3, hbm / 1024**3,
-            )
 
     # ------------------------------------------------------------ helpers
 
@@ -602,12 +575,45 @@ class Trainer:
     # --------------------------------------------------------------- eval
 
     def evaluate(
-        self, episodes: int = 10, deterministic: bool = True, render: bool = False
+        self,
+        episodes: int = 10,
+        deterministic: bool = True,
+        render: bool = False,
+        seed: int | None = None,
     ) -> dict:
-        """Rollout loop (ref ``run_agent.run_agent``, ``run_agent.py:19-48``)."""
+        """Rollout loop (ref ``run_agent.run_agent``, ``run_agent.py:19-48``).
+
+        ``seed`` makes the whole evaluation reproducible: episode ``i``
+        resets its env with ``seed + i`` (the reference's per-episode
+        seeding discipline, ref ``sac/algorithm.py:203-205``), and the
+        acting PRNG key is re-keyed from ``seed`` so even
+        ``deterministic=False`` rollouts replay exactly. ``None`` keeps
+        OS-entropy resets.
+        """
+        saved_key = self._act_key
+        if seed is not None:
+            eval_key = jax.random.key(seed)
+            if self.config.host_actor:
+                # Keep the host_actor key placement (__init__ pins the
+                # acting key host-side so per-step splits don't pay a
+                # device round-trip over a high-latency link).
+                eval_key = jax.device_put(eval_key, self._host_device)
+            self._act_key = eval_key
+        try:
+            return self._evaluate_episodes(episodes, deterministic, render, seed)
+        finally:
+            # Restore the training exploration stream: a periodic seeded
+            # eval must not make every post-eval epoch replay identical
+            # exploration noise.
+            self._act_key = saved_key
+
+    def _evaluate_episodes(
+        self, episodes: int, deterministic: bool, render: bool, seed: int | None
+    ) -> dict:
         returns, lengths = [], []
-        for _ in range(episodes):
-            o = self._normalize(self.pool.reset_at(0), update=False)
+        for ep in range(episodes):
+            ep_seed = None if seed is None else seed + ep
+            o = self._normalize(self.pool.reset_at(0, seed=ep_seed), update=False)
             done = False
             ret, length = 0.0, 0
             while not done and length < self.config.max_ep_len:
