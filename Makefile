@@ -4,11 +4,15 @@
 
 .PHONY: test test-fast bench dryrun lint native clean tpu-smoke parity multihost
 
+# Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
 	python -m pytest tests/ -q
 
+# Iteration default: skips the @pytest.mark.slow tests (>30s each:
+# multi-process launches, long training loops, native ASan build) and
+# the composer wall-runner construction. <5 min.
 test-fast:
-	python -m pytest tests/ -q -x --ignore=tests/test_wall_runner_env.py
+	python -m pytest tests/ -q -x -m "not slow" --ignore=tests/test_wall_runner_env.py
 
 bench:
 	python bench.py

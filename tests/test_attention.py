@@ -231,6 +231,7 @@ def test_context_parallel_actor_stochastic_logprob():
     assert bool(jnp.all(jnp.isfinite(logp)))
 
 
+@pytest.mark.slow
 def test_sequence_double_critic_shapes():
     critic = SequenceDoubleCritic(d_model=32, num_heads=2, num_layers=1, max_len=64)
     obs = jax.random.normal(jax.random.key(10), (4, 8, 5))

@@ -186,6 +186,7 @@ class TestBfloat16Compute:
         with pytest.raises(ValueError):
             SACConfig(compute_dtype="float16")
 
+    @pytest.mark.slow
     def test_bf16_sequence_and_visual_forward(self):
         from torch_actor_critic_tpu.core.types import MultiObservation
         from torch_actor_critic_tpu.models import SequenceActor, VisualActor

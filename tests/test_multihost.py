@@ -25,6 +25,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_launcher_runs_two_process_selftest(tmp_path):
     """The mpi_fork-counterpart launcher (parallel/launch.py) drives
     the same 2-process selftest: one command line fans out to N
@@ -86,6 +87,7 @@ def test_launcher_fast_fails_and_passes_literal_braces():
     assert time.time() - t0 < 60  # rank 0's 120s sleep was terminated
 
 
+@pytest.mark.slow
 def test_two_process_distributed_dryrun(tmp_path):
     # (hang protection comes from the subprocess communicate timeout)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -289,6 +289,7 @@ class TestHistoryEnv:
             np.asarray(outs.next_obs[-1][-1]), np.asarray(s.obs[-1])
         )
 
+    @pytest.mark.slow
     def test_fused_sequence_epoch(self):
         """SequenceActor/Critic train through the fused loop on-chip
         (wired by train_on_device for --on-device --history-len N)."""

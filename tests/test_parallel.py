@@ -6,6 +6,7 @@ paths are never exercised in CI (SURVEY.md §4). Here ``shard_map`` +
 """
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -62,8 +63,6 @@ def test_local_dp_info_rejects_zero_slice_process(monkeypatch):
     topology) must fail with a layout-naming error up front, not build a
     0-env pool and die obscurely in reset_all. Simulated by pretending
     to be process 1 of a mesh wholly owned by process 0."""
-    import pytest
-
     from torch_actor_critic_tpu.parallel.mesh import local_dp_info
 
     mesh = make_mesh(dp=4, tp=2)
@@ -83,6 +82,7 @@ def test_sharded_buffer_layout():
     assert len(buf.data.states.sharding.device_set) == 8
 
 
+@pytest.mark.slow
 def test_dp_burst_runs_and_replicas_stay_synced():
     dp = make_dp()
     state = dp.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
@@ -276,6 +276,7 @@ def test_dp_tp_hybrid_matches_dp_only():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sp_gradient_path_matches_unsharded():
     """VERDICT round-1 #5: the sequence axis sharded over sp in the
     TRAINING step itself. A (dp=2, sp=2) burst over sequence models —
@@ -384,6 +385,7 @@ def test_sp_rejects_indivisible_and_oversized_histories():
         dp._check_sp_shapes(chunk)
 
 
+@pytest.mark.slow
 def test_sp_loss_gradients_match_unsharded():
     """Adam hides uniform grad-scale errors, so check the gradients
     themselves: critic-loss grads computed with ring attention over a

@@ -7,6 +7,7 @@ sequence extension trains through the identical algorithm path
 """
 
 import jax
+import pytest
 import numpy as np
 
 from torch_actor_critic_tpu.envs.wrappers import HistoryEnv, make_env
@@ -45,6 +46,7 @@ def test_history_env_window_semantics():
     env.close()
 
 
+@pytest.mark.slow
 def test_sequence_sac_trains_end_to_end():
     tr = Trainer("Pendulum-v1", SACConfig(**SEQ_TINY), mesh=make_mesh(dp=2), seed=1)
     from torch_actor_critic_tpu.models import SequenceActor
@@ -59,6 +61,7 @@ def test_sequence_sac_trains_end_to_end():
     tr.close()
 
 
+@pytest.mark.slow
 def test_sequence_sac_trains_with_sp_sharded_histories():
     """Capstone integration: the HOST trainer end-to-end on a (dp=2,
     sp=2) mesh — history windows staged by the env loop, sharded over

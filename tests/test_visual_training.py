@@ -126,6 +126,7 @@ def test_visual_evaluate(visual_trainer):
     assert np.isfinite(ev["ep_ret_mean"])
 
 
+@pytest.mark.slow
 def test_wall_runner_visual_training_real_env():
     """BASELINE config 5 end-to-end on the REAL environment (round-1
     missing #6: the visual stack had only ever trained against
