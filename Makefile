@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench dryrun lint native clean tpu-smoke parity multihost
+.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -20,6 +20,11 @@ bench:
 # Real-chip smoke: Pallas kernels fwd+bwd, fused burst, on-device env.
 tpu-smoke:
 	python scripts/tpu_smoke.py
+
+# Poll the TPU tunnel and capture chip evidence into runs/tpu/ whenever
+# it answers (leave running in the background for a whole session).
+tpu-watch:
+	bash scripts/tpu_watch.sh
 
 # Return-parity runs vs the shared torch baseline (see PARITY.md).
 parity:

@@ -143,3 +143,31 @@ def test_adam_single_step_matches_torch():
     w_t.grad = torch.tensor(g)
     opt.step()
     np.testing.assert_allclose(w_jax, w_t.detach().numpy(), rtol=1e-5, atol=1e-7)
+
+
+def test_torch_visual_baseline_builds_and_updates():
+    """The visual torch baseline (bench.py's BASELINE-config-5 ratio,
+    baselines/torch_sac.py:build_torch_visual_sac) runs a full SAC
+    gradient step at a tiny geometry: actor output contracts hold and
+    the update mutates parameters. 36x36 is the smallest square frame
+    the hardwired Atari conv geometry (8,4,3)/(4,2,1) admits."""
+    from torch_actor_critic_tpu.baselines import build_torch_visual_sac
+
+    feat, hw, c, act_dim, batch = 6, (36, 36), 3, 4, 5
+    actor, update = build_torch_visual_sac(feat, hw, c, act_dim, hidden=(16, 16))
+    frames = torch.rand(batch, c, *hw) * 255.0
+    feats = torch.randn(batch, feat)
+    with torch.no_grad():
+        a, logp = actor(feats, frames)
+    assert a.shape == (batch, act_dim) and logp.shape == (batch,)
+    assert bool((a.abs() <= 1.0).all())
+    before = [p.detach().clone() for p in actor.parameters()]
+    update(
+        feats, frames, torch.tanh(torch.randn(batch, act_dim)),
+        torch.randn(batch), torch.randn(batch, feat),
+        torch.rand(batch, c, *hw) * 255.0, torch.zeros(batch),
+    )
+    after = list(actor.parameters())
+    assert any(
+        not torch.equal(b, a.detach()) for b, a in zip(before, after)
+    )

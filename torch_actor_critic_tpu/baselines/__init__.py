@@ -4,4 +4,7 @@ numbers, BASELINE.md): independent PyTorch code used by ``bench.py``
 baseline). One implementation so the two comparisons can never drift
 apart."""
 
-from torch_actor_critic_tpu.baselines.torch_sac import build_torch_sac  # noqa: F401
+from torch_actor_critic_tpu.baselines.torch_sac import (  # noqa: F401
+    build_torch_sac,
+    build_torch_visual_sac,
+)

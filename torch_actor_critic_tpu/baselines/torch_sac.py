@@ -17,6 +17,77 @@ from __future__ import annotations
 import typing as t
 
 
+def _squashed_gaussian(mu, log_std, act_limit, deterministic):
+    """Shared squashed-Gaussian sample + log-prob (ref
+    ``networks/linear.py:39-51`` semantics) — one copy for the flat and
+    visual actors so the distribution math cannot drift."""
+    import numpy as np
+    import torch
+    import torch.nn.functional as F
+
+    log_std = torch.clip(log_std, -20, 2)
+    std = torch.exp(log_std)
+    u = mu if deterministic else mu + std * torch.randn_like(mu)
+    a = torch.tanh(u) * act_limit
+    logp = torch.distributions.Normal(mu, std).log_prob(u).sum(-1)
+    logp = logp - (2 * (np.log(2) - u - F.softplus(-2 * u))).sum(-1)
+    return a, logp
+
+
+def _make_sac_update(actor, critics, targets, lr, alpha, gamma, polyak):
+    """Shared SAC gradient step over tuple-observations.
+
+    ``actor(*obs)`` -> (action, logp); ``critic(*obs, a)`` -> q. The
+    flat and visual builders differ ONLY in network definitions and obs
+    arity — the backup, twin-Q loss, frozen-critic policy step and
+    polyak averaging live here once (the package docstring's 'cannot
+    drift' contract, kept after the visual twin landed).
+    Returns ``update(obs_tuple, a, r, obs2_tuple, d)``.
+    """
+    import torch
+
+    for c, tgt in zip(critics, targets):
+        tgt.load_state_dict(c.state_dict())
+        for p in tgt.parameters():
+            p.requires_grad_(False)
+    pi_opt = torch.optim.Adam(actor.parameters(), lr=lr)
+    q_opt = torch.optim.Adam(
+        [p for c in critics for p in c.parameters()], lr=lr
+    )
+
+    def update(obs, a, r, obs2, d):
+        with torch.no_grad():
+            a2, logp2 = actor(*obs2)
+            qt = torch.min(*(tg(*obs2, a2) for tg in targets))
+            backup = r + gamma * (1 - d) * (qt - alpha * logp2)
+        q1, q2 = (c(*obs, a) for c in critics)
+        loss_q = ((q1 - backup) ** 2).mean() + ((q2 - backup) ** 2).mean()
+        q_opt.zero_grad()
+        loss_q.backward()
+        q_opt.step()
+
+        for c in critics:
+            for p in c.parameters():
+                p.requires_grad_(False)
+        pi, logp = actor(*obs)
+        loss_pi = (
+            alpha * logp - torch.min(*(c(*obs, pi) for c in critics))
+        ).mean()
+        pi_opt.zero_grad()
+        loss_pi.backward()
+        pi_opt.step()
+        for c in critics:
+            for p in c.parameters():
+                p.requires_grad_(True)
+
+        with torch.no_grad():
+            for c, tgt in zip(critics, targets):
+                for pc, pt in zip(c.parameters(), tgt.parameters()):
+                    pt.mul_(polyak).add_((1 - polyak) * pc)
+
+    return update
+
+
 def build_torch_sac(
     obs_dim: int,
     act_dim: int,
@@ -37,10 +108,8 @@ def build_torch_sac(
 
     ``torch.set_num_threads(num_threads)`` mirrors ref ``main.py:130``.
     """
-    import numpy as np
     import torch
     import torch.nn as nn
-    import torch.nn.functional as F
 
     torch.set_num_threads(num_threads)
 
@@ -59,62 +128,122 @@ def build_torch_sac(
 
         def forward(self, obs, deterministic=False):
             h = self.trunk(obs)
-            mu = self.mu(h)
-            log_std = torch.clip(self.log_std(h), -20, 2)
-            std = torch.exp(log_std)
-            u = mu if deterministic else mu + std * torch.randn_like(mu)
-            a = torch.tanh(u) * act_limit
-            logp = torch.distributions.Normal(mu, std).log_prob(u).sum(-1)
-            logp = logp - (2 * (np.log(2) - u - F.softplus(-2 * u))).sum(-1)
-            return a, logp
+            return _squashed_gaussian(
+                self.mu(h), self.log_std(h), act_limit, deterministic
+            )
 
-    def critic():
-        net = mlp([obs_dim + act_dim, *hidden])
-        net.append(nn.Linear(hidden[-1], 1))
-        return net
+    class Critic(nn.Module):
+        def __init__(self):
+            super().__init__()
+            net = mlp([obs_dim + act_dim, *hidden])
+            net.append(nn.Linear(hidden[-1], 1))
+            self.net = net
+
+        def forward(self, s, a):
+            return self.net(torch.cat([s, a], -1)).squeeze(-1)
 
     actor = Actor()
-    critics = [critic(), critic()]
-    targets = [critic(), critic()]
-    for c, tgt in zip(critics, targets):
-        tgt.load_state_dict(c.state_dict())
-        for p in tgt.parameters():
-            p.requires_grad_(False)
-    pi_opt = torch.optim.Adam(actor.parameters(), lr=lr)
-    q_opt = torch.optim.Adam(
-        [p for c in critics for p in c.parameters()], lr=lr
-    )
-
-    def q_of(nets, s, a):
-        x = torch.cat([s, a], -1)
-        return [net(x).squeeze(-1) for net in nets]
+    critics = [Critic(), Critic()]
+    targets = [Critic(), Critic()]
+    inner = _make_sac_update(actor, critics, targets, lr, alpha, gamma, polyak)
 
     def update(s, a, r, s2, d):
-        with torch.no_grad():
-            a2, logp2 = actor(s2)
-            qt = torch.min(*q_of(targets, s2, a2))
-            backup = r + gamma * (1 - d) * (qt - alpha * logp2)
-        q1, q2 = q_of(critics, s, a)
-        loss_q = ((q1 - backup) ** 2).mean() + ((q2 - backup) ** 2).mean()
-        q_opt.zero_grad()
-        loss_q.backward()
-        q_opt.step()
+        inner((s,), a, r, (s2,), d)
 
-        for c in critics:
-            for p in c.parameters():
-                p.requires_grad_(False)
-        pi, logp = actor(s)
-        loss_pi = (alpha * logp - torch.min(*q_of(critics, s, pi))).mean()
-        pi_opt.zero_grad()
-        loss_pi.backward()
-        pi_opt.step()
-        for c in critics:
-            for p in c.parameters():
-                p.requires_grad_(True)
+    return actor, update
 
-        with torch.no_grad():
-            for c, tgt in zip(critics, targets):
-                for pc, pt in zip(c.parameters(), tgt.parameters()):
-                    pt.mul_(polyak).add_((1 - polyak) * pc)
+
+def build_torch_visual_sac(
+    feature_dim: int,
+    frame_hw: t.Tuple[int, int],
+    frame_channels: int,
+    act_dim: int,
+    act_limit: float = 1.0,
+    hidden: t.Sequence[int] = (256, 256),
+    cnn_features: int = 1,
+    lr: float = 3e-4,
+    alpha: float = 0.2,
+    gamma: float = 0.99,
+    polyak: float = 0.995,
+    num_threads: int = 2,
+):
+    """Visual (CNN) twin of :func:`build_torch_sac` — the measured torch
+    stand-in for the reference's pixel stack (BASELINE config 5).
+
+    Same architecture semantics as the reference visual networks
+    (ref ``networks/convolutional.py:30-183``): Atari-DQN conv trunk
+    (filters [32,64,64], kernels [8,4,3], strides [4,2,1], VALID
+    padding) -> Dense(512) -> Dense(``cnn_features``, default 1 — the
+    scalar-vision bottleneck), concatenated with the proprioceptive MLP;
+    the critic ReLUs through every MLP layer including the width-1
+    output then applies the final ``Linear(1+cnn_features, 1)``. NCHW
+    float frames, as the reference stores them. Shares no code with
+    ``/root/reference``.
+
+    Returns ``(actor_fn, update_fn)``; ``update_fn(feat, frame, a, r,
+    feat2, frame2, d)`` runs one full SAC gradient step.
+    """
+    import torch
+    import torch.nn as nn
+
+    torch.set_num_threads(num_threads)
+
+    def mlp(sizes, relu_final=False):
+        layers = []
+        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+            layers.append(nn.Linear(a, b))
+            if relu_final or i < len(sizes) - 2:
+                layers.append(nn.ReLU())
+        return nn.Sequential(*layers)
+
+    def cnn():
+        h, w = frame_hw
+        convs = []
+        c = frame_channels
+        for f, k, s in zip((32, 64, 64), (8, 4, 3), (4, 2, 1)):
+            convs += [nn.Conv2d(c, f, k, s), nn.ReLU()]
+            c = f
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+        return nn.Sequential(
+            *convs, nn.Flatten(),
+            nn.Linear(c * h * w, 512), nn.Linear(512, cnn_features),
+        )
+
+    class Actor(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.trunk = mlp([feature_dim, *hidden], relu_final=True)
+            self.vision = cnn()
+            self.mu = nn.Linear(hidden[-1] + cnn_features, act_dim)
+            self.log_std = nn.Linear(hidden[-1] + cnn_features, act_dim)
+
+        def forward(self, feat, frame, deterministic=False):
+            h = torch.cat([self.trunk(feat), self.vision(frame)], -1)
+            return _squashed_gaussian(
+                self.mu(h), self.log_std(h), act_limit, deterministic
+            )
+
+    class Critic(nn.Module):
+        def __init__(self):
+            super().__init__()
+            # ReLU through every layer incl. the width-1 output — the
+            # reference quirk (ref convolutional.py:156-158).
+            self.trunk = mlp([feature_dim + act_dim, *hidden, 1], relu_final=True)
+            self.vision = cnn()
+            self.final = nn.Linear(1 + cnn_features, 1)
+
+        def forward(self, feat, frame, act):
+            x = self.trunk(torch.cat([feat, act], -1))
+            x = torch.cat([x, self.vision(frame)], -1)
+            return self.final(x).squeeze(-1)
+
+    actor = Actor()
+    critics = [Critic(), Critic()]
+    targets = [Critic(), Critic()]
+    inner = _make_sac_update(actor, critics, targets, lr, alpha, gamma, polyak)
+
+    def update(feat, frame, a, r, feat2, frame2, d):
+        inner((feat, frame), a, r, (feat2, frame2), d)
 
     return actor, update
