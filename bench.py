@@ -216,6 +216,45 @@ def sac_flops_per_step(batch=BATCH, hidden=HIDDEN, obs=OBS_DIM, act=ACT_DIM):
     return 2 * batch * macs
 
 
+def visual_flops_per_step(feat=168, frame=(64, 64, 3), act_dim=56,
+                          batch=32, hidden=(256, 256), cnn_features=1):
+    """Analytic FLOPs for one visual SAC gradient step (same fwd/bwd
+    weighting as :func:`sac_flops_per_step`), dominated by the four CNN
+    towers (actor + twin critic, each with its own conv trunk)."""
+    def cnn_macs():
+        h, w, c = frame
+        macs = 0
+        for f, k, s in zip((32, 64, 64), (8, 4, 3), (4, 2, 1)):
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+            macs += h * w * f * k * k * c
+            c = f
+        macs += (h * w * c) * 512 + 512 * cnn_features
+        return macs
+
+    def mlp_macs(sizes):
+        return sum(a * b for a, b in zip(sizes[:-1], sizes[1:]))
+
+    actor = (
+        cnn_macs() + mlp_macs([feat, *hidden])
+        + 2 * (hidden[-1] + cnn_features) * act_dim
+    )
+    critic_mlp = 2 * (mlp_macs([feat + act_dim, *hidden, 1]) + (1 + cnn_features))
+    critic = 2 * cnn_macs() + critic_mlp  # twin, each with its own CNN tower
+    macs = (
+        actor          # pi(s') for the backup (no grad)
+        + critic       # target twin fwd
+        + 3 * critic   # critic twin fwd+bwd
+        + 3 * actor    # actor fwd+bwd (policy loss)
+        # frozen-critic policy step: full fwd, but the input-only
+        # backward only traverses the MLP branch — the frame input is
+        # constant data, so no gradient ever flows through the conv
+        # towers (autograd skips them; XLA DCEs them).
+        + critic + critic_mlp
+    )
+    return 2 * batch * macs
+
+
 def _make_bench_fn(obs_dim, act_dim, hidden, batch, capacity=1_000_000,
                    compute_dtype="float32"):
     import jax
@@ -545,6 +584,10 @@ def bench_visual(budget_s=300.0, burst=25):
         sps = run(20)
     out["grad_steps_per_sec"] = round(sps, 1)
     out["examples_per_sec"] = round(sps * batch, 0)
+    out.update(mfu_metrics(
+        sps, jax.devices()[0].device_kind,
+        flops=visual_flops_per_step(feat, frame, act_dim, batch),
+    ))
 
     # Reference-style torch-CPU visual baseline at the same geometry
     # (BASELINE config 5's ratio; the flat headline has its own).
@@ -800,11 +843,12 @@ def peak_flops_for(device_kind):
     return None
 
 
-def mfu_metrics(acc_sps, device_kind):
-    """Achieved-FLOPs/MFU keys for a measured headline number — shared
-    by main() and scripts/tpu_capture.py so driver JSON lines and
-    persisted chip artifacts compute these identically."""
-    flops = sac_flops_per_step()
+def mfu_metrics(acc_sps, device_kind, flops=None):
+    """Achieved-FLOPs/MFU keys for a measured steps/sec number — shared
+    by main(), the visual section and scripts/tpu_capture.py so driver
+    JSON lines and persisted chip artifacts compute these identically.
+    ``flops`` defaults to the flat headline's analytic per-step cost."""
+    flops = sac_flops_per_step() if flops is None else flops
     out = {
         "flops_per_step": flops,
         "achieved_flops_per_sec": round(acc_sps * flops, 0),

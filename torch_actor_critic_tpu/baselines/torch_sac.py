@@ -17,13 +17,29 @@ from __future__ import annotations
 import typing as t
 
 
+_MODS = None  # (np, torch, F) — imported once, on first use
+
+
+def _mods():
+    """Lazy module triple: torch stays un-imported until a baseline is
+    actually built (same convention as the builders), but the per-step
+    hot path pays one global check instead of three sys.modules
+    lookups per call."""
+    global _MODS
+    if _MODS is None:
+        import numpy as np
+        import torch
+        import torch.nn.functional as F
+
+        _MODS = (np, torch, F)
+    return _MODS
+
+
 def _squashed_gaussian(mu, log_std, act_limit, deterministic):
     """Shared squashed-Gaussian sample + log-prob (ref
     ``networks/linear.py:39-51`` semantics) — one copy for the flat and
     visual actors so the distribution math cannot drift."""
-    import numpy as np
-    import torch
-    import torch.nn.functional as F
+    np, torch, F = _mods()
 
     log_std = torch.clip(log_std, -20, 2)
     std = torch.exp(log_std)
