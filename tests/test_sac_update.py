@@ -200,3 +200,34 @@ def test_redq_wide_ensemble_updates():
             jax.tree_util.tree_map(lambda x: x[i], new_state.critic_params)
         )[0]
         assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_update_burst_donates_buffer_in_hlo(sac_and_state):
+    """Perf-regression guard: the fused burst's replay buffer MUST be
+    donated (input-output aliased in the compiled HLO). Losing donation
+    would silently deep-copy the multi-GB HBM buffer on every dispatch
+    — the exact host<->device-free replay design the framework trades
+    on (SURVEY.md §7; bench.py measures through this jit signature).
+
+    Differential: the same burst is compiled with and without the
+    buffer in donate_argnums, and the alias-count delta must cover the
+    buffer's 7 leaves (5 Batch fields + ptr + size) — train-state
+    donation alone cannot satisfy this, so a regression that drops
+    ONLY the buffer from donation turns the test red."""
+    sac, state = sac_and_state
+    buf = init_replay_buffer(256, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32), ACT_DIM)
+    buf = jax.jit(push, donate_argnums=(0,))(buf, make_batch(jax.random.key(2), 64))
+
+    def alias_count(donate):
+        hlo = (
+            jax.jit(sac.update_burst, static_argnums=(3,), donate_argnums=donate)
+            .lower(state, buf, make_batch(jax.random.key(3), 10), 5)
+            .compile()
+            .as_text()
+        )
+        return hlo.count("must-alias") + hlo.count("may-alias")
+
+    with_buffer = alias_count((0, 1))
+    state_only = alias_count((0,))
+    assert with_buffer - state_only >= 7, (with_buffer, state_only)
