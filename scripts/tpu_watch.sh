@@ -27,7 +27,10 @@ assert float((jnp.ones((8,8))@jnp.ones((8,8)))[0,0]) == 8.0
         # (structured diagnostics) rather than by this kill.
         timeout 5400 python scripts/tpu_capture.py 2>&1 \
             | tee "runs/tpu/capture_${stamp}.log" | tail -3
-        timeout 900 python scripts/tpu_smoke.py >"runs/tpu/smoke_${stamp}.log" 2>&1
+        # First-compile of the smoke's five stages (Mosaic flash bwd,
+        # sequence burst) takes >15 min on the tunneled chip; 900s lost
+        # the later stages to the outer kill.
+        timeout 2400 python scripts/tpu_smoke.py >"runs/tpu/smoke_${stamp}.log" 2>&1
         tail -2 "runs/tpu/smoke_${stamp}.log"
         echo "[tpu_watch] capture done; next refresh in ${REFRESH_SLEEP}s"
         sleep "$REFRESH_SLEEP"
