@@ -321,3 +321,38 @@ class TestHistoryEnv:
         assert np.isfinite(float(m["loss_q"]))
         assert np.isfinite(float(m["loss_pi"]))
         assert int(buf.size) == 160  # 2 epochs x 20 steps x 4 envs
+
+
+def test_on_device_run_evaluates_through_host_eval_cli(tmp_path):
+    """A run trained with the fused on-device loop must load through the
+    product eval CLI and roll out on the real host env — the crossover
+    ``scripts/tpu_train_proof.py`` relies on (checkpoint layout shared
+    between OnDeviceLoop and the host Trainer, buffer excluded)."""
+    from torch_actor_critic_tpu.run_agent import main as eval_main
+    from torch_actor_critic_tpu.train import main as train_main
+
+    train_main([
+        "--environment", "Pendulum-v1",
+        "--on-device", "true",
+        "--on-device-envs", "2",
+        "--devices", "1",
+        "--runs-root", str(tmp_path),
+        "--epochs", "1",
+        "--steps-per-epoch", "40",
+        "--update-every", "20",
+        "--start-steps", "20",
+        "--update-after", "20",
+        "--batch-size", "16",
+        "--buffer-size", "500",
+        "--hidden-sizes", "16,16",
+    ])
+    run_id = next((tmp_path / "Default").iterdir()).name
+    metrics = eval_main([
+        "--run", run_id,
+        "--runs-root", str(tmp_path),
+        "--episodes", "2",
+        "--headless",
+        "--seed", "0",
+    ])
+    assert np.isfinite(metrics["ep_ret_mean"])
+    assert metrics["ep_len_mean"] == 200.0
