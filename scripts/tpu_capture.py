@@ -78,7 +78,7 @@ def main() -> int:
 
     for stage, timeout_s in (
         ("headline_bf16", 600),
-        ("sweep", 600),
+        ("sweep", 900),
         ("visual", 480),
         ("on_device", 540),
         ("attention", 600),
