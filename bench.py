@@ -492,6 +492,21 @@ def bench_attention(budget_s=180.0, t=2048):
             dt = timed(bwd, q, k, v, g)
             out["fwd_bwd_ms"] = round(dt * 1e3, 2)
             out["fwd_bwd_tflops"] = round(flops_bwd / dt / 1e12, 2)
+
+        # bf16 operands: the kernels keep sub-f32 dtypes on the MXU
+        # (f32 accumulation) — the dtype the sequence stack trains in
+        # under compute_dtype=bfloat16, and the fast systolic path.
+        if time.time() - t_start < budget_s:
+            qb, kb, vb, gb = (
+                x.astype(jnp.bfloat16) for x in (q, k, v, g)
+            )
+            dt = timed(fwd, qb, kb, vb)
+            out["fwd_ms_bf16"] = round(dt * 1e3, 2)
+            out["fwd_tflops_bf16"] = round(flops_fwd / dt / 1e12, 2)
+        if time.time() - t_start < budget_s:
+            dt = timed(bwd, qb, kb, vb, gb)
+            out["fwd_bwd_ms_bf16"] = round(dt * 1e3, 2)
+            out["fwd_bwd_tflops_bf16"] = round(flops_bwd / dt / 1e12, 2)
         log(f"attention: {out}")
     except Exception as e:  # noqa: BLE001 — best-effort section
         out["error"] = repr(e)

@@ -240,3 +240,43 @@ def test_sequence_double_critic_shapes():
     qs = critic.apply(params, obs, act)
     assert qs.shape == (2, 4)
     assert bool(jnp.all(jnp.isfinite(qs)))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bf16_operands_match_reference(causal):
+    """bf16 q/k/v through fwd AND bwd: the kernels keep operands in
+    their storage dtype on the MXU (f32 accumulation; probability/ds
+    tiles cast down for the second matmul), so the result must track a
+    dense f32 reference within bf16 tolerance — pins the
+    mixed-precision path the sequence stack uses under
+    compute_dtype=bfloat16."""
+    q32, k32, v32 = qkv(40, b=2, h=2, t=32, d=16)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q32, k32, v32))
+    g = jax.random.normal(jax.random.key(41), q32.shape)
+
+    expected = reference_attention(q32, k32, v32, causal=causal)
+    got = flash_attention(q, k, v, causal, 8, 8, True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        got.astype(jnp.float32), expected, atol=3e-2, rtol=3e-2
+    )
+
+    _, vjp_flash = jax.vjp(
+        lambda q, k, v: flash_attention(q, k, v, causal, 8, 8, True), q, k, v
+    )
+    _, vjp_ref = jax.vjp(
+        lambda q, k, v: reference_attention(q, k, v, causal=causal),
+        q32, k32, v32,
+    )
+    for gf, gr in zip(vjp_flash(g.astype(jnp.bfloat16)), vjp_ref(g)):
+        assert gf.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            gf.astype(jnp.float32), gr, atol=6e-2, rtol=6e-2
+        )
+
+
+def test_flash_rejects_mixed_operand_dtypes():
+    q, k, v = qkv(50, t=16, d=16)
+    with pytest.raises(ValueError, match="share one dtype"):
+        flash_attention(q, k.astype(jnp.bfloat16), v.astype(jnp.bfloat16),
+                        False, 8, 8, True)

@@ -183,6 +183,27 @@ def blockwise_attention(
 _LANE = 128  # TPU lane width: last tile dim, and scratch column count
 
 
+def _acc_dot(a: jax.Array, b: jax.Array, dims) -> jax.Array:
+    """``dot_general`` with f32 accumulation on MXU-native operands.
+
+    Operands keep their storage dtype (bf16 stays bf16 — the MXU's fast
+    mixed-precision path; upcasting to f32 first would force the ~4x
+    slower f32 systolic passes). When exactly one side is an f32
+    intermediate (the probability/ds tiles) and the other is sub-f32,
+    the intermediate is cast DOWN to match — FlashAttention's standard
+    TPU scheme; bf16 probabilities are inside the softmax's own error
+    budget. f32-in/f32-out math is bit-identical to a plain f32 dot.
+    """
+    if a.dtype != b.dtype:
+        if a.dtype == jnp.float32:
+            a = a.astype(b.dtype)
+        else:
+            b = b.astype(a.dtype)
+    return jax.lax.dot_general(
+        a, b, (dims, ((), ())), preferred_element_type=jnp.float32
+    )
+
+
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, *rest,
     block_q: int, block_k: int, scale: float, causal: bool,
@@ -220,13 +241,10 @@ def _flash_kernel(
 
     @pl.when(needed)
     def _update():
-        q = q_ref[0].astype(jnp.float32)
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        scores = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        scores = _acc_dot(q, k_blk, ((1,), (1,))) * scale
         if causal:
             q_pos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -242,9 +260,8 @@ def _flash_kernel(
         p = jnp.where(jnp.isneginf(scores), 0.0, p)
         alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
         l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + _acc_dot(
+            p, v_blk, ((1,), (0,))
         )
         m_ref[:, 0] = m_new
 
@@ -321,6 +338,13 @@ def _flash_forward(
     b, h, tq, d = q.shape
     tk = k.shape[2]
     block_q, block_k = _check_blocks(tq, tk, block_q, block_k)
+    if not (q.dtype == k.dtype == v.dtype):
+        # _acc_dot's downcast rule is only safe for the kernels' own f32
+        # intermediates; a mixed-dtype *input* would be silently rounded.
+        raise ValueError(
+            "flash_attention requires q/k/v to share one dtype, got "
+            f"{q.dtype}/{k.dtype}/{v.dtype}; cast the operands first."
+        )
     # The softmax scale uses the *logical* head dim; zero-pad the head
     # axis to the lane width (dot products are unchanged by zero columns,
     # padded output columns are sliced away).
@@ -365,6 +389,11 @@ def _flash_forward(
             pltpu.VMEM((block_q, _LANE), jnp.float32),  # l (col 0)
             pltpu.VMEM((block_q, dp), jnp.float32),     # acc
         ],
+        # bh and q-block programs are independent; the k sweep carries
+        # the online-softmax scratch and must stay sequential.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qr, kr, vr)
     out = outs[0].reshape(b, h, tq, dp)[..., :d]
@@ -380,9 +409,7 @@ def _attn_probs(q, k, lse, scale, causal, iq, jk, block_q, block_k):
     weights, recovered without re-running the online max/normalizer scan.
     Shared by both backward kernels.
     """
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
+    s = _acc_dot(q, k, ((1,), (1,))) * scale
     if causal:
         q_pos = iq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
@@ -420,22 +447,16 @@ def _flash_bwd_dq_kernel(
 
     @pl.when(needed)
     def _update():
-        q = q_ref[0].astype(jnp.float32)
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        do = do_ref[0]
         p = _attn_probs(
             q, k_blk, lse_ref[0][:, 0], scale, causal, iq, j, block_q, block_k
         )
-        dpv = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        dpv = _acc_dot(do, v_blk, ((1,), (1,)))
         ds = p * (dpv - delta_ref[0][:, 0][:, None])
-        dq_acc[:] += jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
+        dq_acc[:] += _acc_dot(ds, k_blk, ((1,), (0,))) * scale
 
     @pl.when(j == n_kb - 1)
     def _finalize():
@@ -469,26 +490,17 @@ def _flash_bwd_dkv_kernel(
 
     @pl.when(needed)
     def _update():
-        q = q_ref[0].astype(jnp.float32)
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        do = do_ref[0]
         p = _attn_probs(
             q, k_blk, lse_ref[0][:, 0], scale, causal, i, jk, block_q, block_k
         )
-        dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dpv = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        dv_acc[:] += _acc_dot(p, do, ((0,), (0,)))
+        dpv = _acc_dot(do, v_blk, ((1,), (1,)))
         ds = p * (dpv - delta_ref[0][:, 0][:, None])
-        dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
+        dk_acc[:] += _acc_dot(ds, q, ((0,), (0,))) * scale
 
     @pl.when(i == n_qb - 1)
     def _finalize():
@@ -506,6 +518,10 @@ def _flash_backward(
     tk = k.shape[2]
     block_q, block_k = _check_blocks(tq, tk, block_q, block_k)
     scale = 1.0 / math.sqrt(d)
+    # The forward enforced a single q/k/v dtype; the cotangent can still
+    # arrive wider (e.g. an f32 loss over a bf16 output) — align it so
+    # _acc_dot never downcasts a genuine input unasked.
+    g = g.astype(q.dtype)
     # Δ = rowsum(dO ∘ O): cheap elementwise reduce, fused by XLA; padded
     # head columns of o/g are zero so padding doesn't perturb it.
     delta = jnp.sum(
@@ -541,6 +557,9 @@ def _flash_backward(
         in_specs=[qspec, kspec_dq, kspec_dq, qspec, rowspec, rowspec],
         out_specs=qspec,
         scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qr, kr, vr, gr, lse_r, delta)
 
@@ -568,6 +587,9 @@ def _flash_backward(
             pltpu.VMEM((block_k, dp), jnp.float32),
             pltpu.VMEM((block_k, dp), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qr, kr, vr, gr, lse_r, delta)
 
