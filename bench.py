@@ -33,6 +33,7 @@ The TPU number is measured through the real training path — the fused
 the HBM replay buffer, exactly what the trainer runs.
 """
 
+import functools
 import glob
 import json
 import os
@@ -453,9 +454,11 @@ def bench_attention(budget_s=180.0, t=2048):
             lambda q, k, v: q * 0.999 + 1e-3 * attention(q, k, v, causal=True)
         )
 
-        def loss_vjp(q, k, v, g):
+        def loss_vjp_blocks(q, k, v, g, block_q=128, block_k=128):
             _, vjp = jax.vjp(
-                lambda q, k, v: attention(q, k, v, causal=True), q, k, v
+                lambda q, k, v: attention(
+                    q, k, v, causal=True, block_q=block_q, block_k=block_k
+                ), q, k, v,
             )
             # Fold ALL THREE grads into the chained output (tq == tk
             # here, so shapes match) — returning only dq would let XLA
@@ -463,7 +466,7 @@ def bench_attention(budget_s=180.0, t=2048):
             dq, dk, dv = vjp(g)
             return q * 0.999 + 1e-3 * (dq + dk + dv)
 
-        bwd = jax.jit(loss_vjp)
+        bwd = jax.jit(loss_vjp_blocks)
 
         # causal: half the score matrix is live -> 0.5 * 4*b*h*t^2*d per
         # fwd; bwd recomputes probs and adds dq/dk/dv matmuls (~2.5x).
@@ -507,6 +510,40 @@ def bench_attention(budget_s=180.0, t=2048):
             dt = timed(bwd, qb, kb, vb, gb)
             out["fwd_bwd_ms_bf16"] = round(dt * 1e3, 2)
             out["fwd_bwd_tflops_bf16"] = round(flops_bwd / dt / 1e12, 2)
+
+        # Pallas block-size tuning (TPU only — the XLA path ignores
+        # block_q): fwd+bwd bf16 at a few (block_q, block_k) tilings;
+        # the default is (128, 128).
+        if jax.default_backend() == "tpu":
+            sweep = []
+            for bq, bk in ((128, 256), (256, 256), (256, 512), (512, 512)):
+                if time.time() - t_start > budget_s:
+                    break
+                try:
+                    f = jax.jit(functools.partial(
+                        loss_vjp_blocks, block_q=bq, block_k=bk
+                    ))
+                    dt = timed(f, qb, kb, vb, gb)
+                    sweep.append({
+                        "block_q": bq, "block_k": bk,
+                        "fwd_bwd_ms": round(dt * 1e3, 2),
+                        "fwd_bwd_tflops": round(flops_bwd / dt / 1e12, 2),
+                    })
+                except Exception as e:  # noqa: BLE001 — per-point
+                    sweep.append({"block_q": bq, "block_k": bk,
+                                  "error": repr(e)[:200]})
+            if sweep:
+                out["block_sweep"] = sweep
+                best = max(
+                    (s for s in sweep if "fwd_bwd_tflops" in s),
+                    key=lambda s: s["fwd_bwd_tflops"],
+                    default=None,
+                )
+                if best and "fwd_bwd_tflops_bf16" in out:
+                    out["best_blocks"] = [best["block_q"], best["block_k"]]
+                    out["best_blocks_tflops"] = max(
+                        best["fwd_bwd_tflops"], out["fwd_bwd_tflops_bf16"]
+                    )
         log(f"attention: {out}")
     except Exception as e:  # noqa: BLE001 — best-effort section
         out["error"] = repr(e)
