@@ -257,7 +257,7 @@ def visual_flops_per_step(feat=168, frame=(64, 64, 3), act_dim=56,
 
 
 def _make_bench_fn(obs_dim, act_dim, hidden, batch, capacity=1_000_000,
-                   compute_dtype="float32"):
+                   compute_dtype="float32", burst_unroll=1):
     import jax
     import jax.numpy as jnp
 
@@ -268,7 +268,8 @@ def _make_bench_fn(obs_dim, act_dim, hidden, batch, capacity=1_000_000,
     from torch_actor_critic_tpu.utils.config import SACConfig
 
     cfg = SACConfig(
-        batch_size=batch, hidden_sizes=hidden, compute_dtype=compute_dtype
+        batch_size=batch, hidden_sizes=hidden, compute_dtype=compute_dtype,
+        burst_unroll=burst_unroll,
     )
     dt = cfg.model_dtype
     sac = SAC(cfg, Actor(act_dim=act_dim, hidden_sizes=hidden, dtype=dt),
@@ -330,6 +331,28 @@ def bench_accelerator(compute_dtype="float32"):
                          compute_dtype=compute_dtype)
     run(5)  # extra warmup beyond compile
     return run(60)
+
+
+def bench_unroll(budget_s=300.0):
+    """Burst-scan unroll tuning at the headline config: the per-step
+    kernels are launch-bound at batch 64 x [256,256], so unrolling the
+    50-step gradient scan trades compile time for loop overhead. The
+    default config stays unroll=1; this reports what the knob buys."""
+    out = []
+    t_start = time.time()
+    for unroll in (1, 2, 5, 10):
+        if time.time() - t_start > budget_s:
+            break
+        entry = {"unroll": unroll}
+        try:
+            run = _make_bench_fn(OBS_DIM, ACT_DIM, HIDDEN, BATCH,
+                                 capacity=100_000, burst_unroll=unroll)
+            run(5)
+            entry["grad_steps_per_sec"] = round(run(40), 1)
+        except Exception as e:  # noqa: BLE001 — per-point best effort
+            entry["error"] = repr(e)[:200]
+        out.append(entry)
+    return out
 
 
 def bench_sweep(budget_s=600.0):
@@ -947,6 +970,7 @@ _STAGES = {
     "headline": _stage_headline,
     "headline_bf16": _stage_headline_bf16,
     "sweep": lambda: {"sweep": bench_sweep()},
+    "unroll": lambda: {"burst_unroll": bench_unroll()},
     "visual": lambda: {"visual": bench_visual()},
     "host_envs": lambda: {"host_envs": bench_host_envs()},
     "on_device": lambda: {"on_device": bench_on_device()},
@@ -1068,7 +1092,8 @@ def main():
         for stage, timeout_s in (
             # attention runs two lengths with 180s internal budgets
             # each; its timeout covers both plus init + compiles.
-            ("sweep", 900), ("on_device", 540), ("attention", 600)
+            ("sweep", 900), ("unroll", 420), ("on_device", 540),
+            ("attention", 900),
         ):
             res = run_stage_subprocess(
                 stage, timeout_s, diagnostics, platform=info.get("platform")

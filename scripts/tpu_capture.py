@@ -79,9 +79,10 @@ def main() -> int:
     for stage, timeout_s in (
         ("headline_bf16", 600),
         ("sweep", 900),
+        ("unroll", 420),
         ("visual", 480),
         ("on_device", 540),
-        ("attention", 600),
+        ("attention", 900),
     ):
         res = bench.run_stage_subprocess(stage, timeout_s, diagnostics, platform)
         if res and "acc_sps_bf16" in res:

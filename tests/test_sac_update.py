@@ -231,3 +231,29 @@ def test_update_burst_donates_buffer_in_hlo(sac_and_state):
     with_buffer = alias_count((0, 1))
     state_only = alias_count((0,))
     assert with_buffer - state_only >= 7, (with_buffer, state_only)
+
+
+def test_update_burst_unroll_is_semantics_preserving():
+    """burst_unroll is a pure scheduling knob: the unrolled scan must
+    produce exactly the same learner state and metrics as unroll=1
+    (including a length that does not divide by the unroll factor)."""
+    results = []
+    for unroll in (1, 4):
+        sac = make_sac(burst_unroll=unroll)
+        state = sac.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
+        buf = init_replay_buffer(
+            64, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32), ACT_DIM
+        )
+        buf = push(buf, make_batch(jax.random.key(5), n=32))
+        chunk = make_batch(jax.random.key(6), n=10)
+        st, _, m = jax.jit(sac.update_burst, static_argnums=(3,))(
+            state, buf, chunk, 6
+        )
+        results.append((st, m))
+    (st1, m1), (st4, m4) = results
+    np.testing.assert_allclose(float(m1["loss_q"]), float(m4["loss_q"]), rtol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(st1.actor_params),
+        jax.tree_util.tree_leaves(st4.actor_params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)

@@ -254,8 +254,10 @@ class SAC:
             st, metrics = self.update(st, batch, axis_name)
             return (st, buf), metrics
 
+        unroll = getattr(self.config, "burst_unroll", 1)
         (state, buffer_state), metrics = jax.lax.scan(
-            body, (state, buffer_state), xs=None, length=num_updates
+            body, (state, buffer_state), xs=None, length=num_updates,
+            unroll=unroll,
         )
         metrics = jax.tree_util.tree_map(jnp.mean, metrics)
         return state, buffer_state, metrics

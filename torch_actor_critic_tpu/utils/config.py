@@ -97,6 +97,12 @@ class SACConfig:
     # instead of a per-step accelerator round trip.
     host_actor: bool = True
 
+    # lax.scan unroll factor for the fused gradient burst
+    # (sac/algorithm.py update_burst). At the reference's tiny model
+    # the per-step kernels are launch-bound on TPU; unrolling trades
+    # compile time and code size for less loop overhead. 1 = plain scan.
+    burst_unroll: int = 1
+
     # Step the host env batch in parallel worker processes over the
     # native shared-memory runtime (envs/vec_env.py + native/). False =
     # in-process sequential stepping. The reference gets env parallelism
