@@ -42,6 +42,14 @@ assert float((jnp.ones((8,8))@jnp.ones((8,8)))[0,0]) == 8.0
                 >"runs/tpu/train_proof_${stamp}.log" 2>&1
             tail -2 "runs/tpu/train_proof_${stamp}.log"
         fi
+        # Artifacts must survive even if nobody is around to commit
+        # them: commit runs/tpu/ (and only it) right away. The rolling
+        # watch.log is gitignored; a no-change cycle commits nothing.
+        git add runs/tpu >/dev/null 2>&1
+        if ! git diff --cached --quiet -- runs/tpu; then
+            git commit -q -m "Record chip evidence captured ${stamp}" -- runs/tpu \
+                && echo "[tpu_watch] committed evidence (${stamp})"
+        fi
         echo "[tpu_watch] capture done; next refresh in ${REFRESH_SLEEP}s"
         sleep "$REFRESH_SLEEP"
     else
