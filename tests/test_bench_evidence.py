@@ -61,3 +61,18 @@ def test_persist_then_load_round_trips(tmp_path, monkeypatch):
     assert "diagnostics" not in rec  # transient noise stays out of evidence
     lk = bench.load_last_known_tpu()
     assert lk["value"] == 123.4 and lk["mfu"] == 0.004
+
+
+def test_capture_stage_names_exist_in_bench_registry():
+    """scripts/tpu_capture.py drives stages by name; a typo would only
+    surface as a chip-side diagnostic when the tunnel is up — pin the
+    names against bench._STAGES here instead."""
+    import re
+    import pathlib
+
+    src = pathlib.Path(__file__, "..", "..", "scripts", "tpu_capture.py")
+    text = src.resolve().read_text()
+    named = set(re.findall(r'\("(\w+)", \d+\)', text)) | {"headline"}
+    assert named, "no stages parsed from tpu_capture.py"
+    unknown = named - set(bench._STAGES)
+    assert not unknown, f"capture references unknown bench stages: {unknown}"
