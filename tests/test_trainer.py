@@ -316,3 +316,34 @@ def test_run_agent_cli_smoke(tmp_path):
         ]
     )
     assert np.isfinite(metrics["ep_ret_mean"])
+
+
+def test_actor_param_lag_trains_and_keeps_mirror_warm():
+    """actor_param_lag=True: the mirror is refreshed from PRE-burst
+    params at dispatch time (one window of staleness, full env/learner
+    overlap) instead of invalidated — training must still progress and
+    the mirror must be populated after a burst, not None. Evaluation
+    resets it to the current params."""
+    cfg = SACConfig(**TINY, actor_param_lag=True)
+    tr = Trainer("Pendulum-v1", cfg, mesh=make_mesh(dp=1))
+    try:
+        metrics = tr.train()
+        assert np.isfinite(metrics["loss_q"])
+        assert tr._host_params is not None  # warm, not invalidated
+        # The warm mirror must hold the PRE-final-burst params: equality
+        # with the current device params would mean the refresh happens
+        # post-burst, re-serializing the env loop on the learner.
+        mirror_leaf = jax.tree_util.tree_leaves(tr._host_params)[0]
+        device_leaf = np.asarray(
+            jax.tree_util.tree_leaves(tr.state.actor_params)[0]
+        )
+        assert not np.allclose(np.asarray(mirror_leaf), device_leaf)
+        ev = tr.evaluate(episodes=1, deterministic=True, seed=7)
+        assert np.isfinite(ev["ep_ret_mean"])
+    finally:
+        tr.close()
+
+
+def test_actor_param_lag_requires_host_actor():
+    with pytest.raises(ValueError, match="actor_param_lag"):
+        SACConfig(actor_param_lag=True, host_actor=False)

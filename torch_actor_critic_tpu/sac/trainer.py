@@ -461,10 +461,24 @@ class Trainer:
                     )
                     staging = []
                     if step > cfg.update_after:
+                        # (config validation guarantees host_actor here)
+                        if cfg.actor_param_lag and step + 1 >= cfg.start_steps:
+                            # Mirror the PRE-burst params now (their
+                            # buffers are still valid — the burst
+                            # donates them) so the next window's acting
+                            # never waits on this burst: full
+                            # env/learner overlap, one window of param
+                            # staleness (opt-in; see SACConfig). While
+                            # acting is still random (< start_steps)
+                            # nothing reads the mirror — skip the sync.
+                            self._host_params = (
+                                self._fetch_params_single_transfer()
+                            )
                         self.state, self.buffer, m = self.dp.update_burst(
                             self.state, self.buffer, chunk, cfg.update_every
                         )
-                        self._host_params = None  # mirror is stale
+                        if not cfg.actor_param_lag:
+                            self._host_params = None  # mirror is stale
                         # Keep device scalars; materialize at epoch end
                         # so bursts stay async behind the env loop.
                         losses_q.append(m["loss_q"])
@@ -591,6 +605,10 @@ class Trainer:
         OS-entropy resets.
         """
         saved_key = self._act_key
+        if self.config.actor_param_lag:
+            # Training may leave the mirror one window stale; evaluation
+            # must always reflect the current policy.
+            self._host_params = None
         if seed is not None:
             eval_key = jax.random.key(seed)
             if self.config.host_actor:

@@ -97,6 +97,14 @@ class SACConfig:
     # instead of a per-step accelerator round trip.
     host_actor: bool = True
 
+    # Overlap env stepping with the gradient burst (host_actor only):
+    # the host mirror is refreshed from the PRE-burst params right
+    # before each burst dispatches, so the env loop never waits for the
+    # burst to finish — at the cost of acting with params one update
+    # window stale (the reference acts on post-update params; off =
+    # parity). Evaluation always refreshes to the current params.
+    actor_param_lag: bool = False
+
     # lax.scan unroll factor for the fused gradient burst
     # (sac/algorithm.py update_burst). At the reference's tiny model
     # the per-step kernels are launch-bound on TPU; unrolling trades
@@ -129,6 +137,12 @@ class SACConfig:
             raise ValueError(
                 f"compute_dtype must be 'float32' or 'bfloat16', got "
                 f"{self.compute_dtype!r}"
+            )
+        if self.actor_param_lag and not self.host_actor:
+            raise ValueError(
+                "actor_param_lag requires host_actor=True — the "
+                "device-actor path reads post-burst params directly, so "
+                "there is no mirror to run stale."
             )
 
     @property
