@@ -102,7 +102,9 @@ def main(argv=None) -> int:
     from torch_actor_critic_tpu.train import main as train_main
 
     exp_dir = pathlib.Path(runs_root, "Default")
-    runs_before = set(p.name for p in exp_dir.iterdir()) if exp_dir.exists() else set()
+    runs_before = (
+        {d.name for d in exp_dir.iterdir()} if exp_dir.exists() else set()
+    )
 
     t0 = time.time()
     metrics = train_main([
@@ -115,11 +117,13 @@ def main(argv=None) -> int:
     ])
     train_s = time.time() - t0
     grad_steps = train_cfg["epochs"] * train_cfg["steps_per_epoch"]
-    # Policy-free warmup phase: start_steps rounded to an update_every
-    # multiple, stepped by every env (mirrors train_on_device's
-    # warmup_steps formula, sac/ondevice.py).
-    ue, ss = train_cfg["update_every"], train_cfg["start_steps"]
-    warmup_env_steps = max(ue, (ss // ue) * ue) * train_cfg["on_device_envs"]
+    # Policy-free warmup phase, stepped by every env (the trainer's own
+    # formula — no drift).
+    from torch_actor_critic_tpu.sac.ondevice import warmup_steps
+
+    warmup_env_steps = warmup_steps(
+        train_cfg["start_steps"], train_cfg["update_every"]
+    ) * train_cfg["on_device_envs"]
     out["train"] = {
         "wall_s": round(train_s, 1),
         "grad_steps": grad_steps,
@@ -131,7 +135,7 @@ def main(argv=None) -> int:
     flush()
     print(f"[proof] trained {grad_steps} grad steps in {train_s:.1f}s -> {path}")
 
-    new_runs = set(p.name for p in exp_dir.iterdir()) - runs_before
+    new_runs = {d.name for d in exp_dir.iterdir()} - runs_before
     if len(new_runs) != 1:
         raise RuntimeError(
             f"expected exactly one new run under {exp_dir}, found {sorted(new_runs)} "

@@ -338,6 +338,14 @@ def _wrap_and_build(env_cls, config) -> t.Tuple[t.Any, SAC]:
     return env_cls, SAC(config, actor, critic, env_cls.act_dim)
 
 
+def warmup_steps(start_steps: int, update_every: int) -> int:
+    """Policy-free warmup length per env: ``start_steps`` rounded down
+    to an ``update_every`` multiple, at least one window (ref warmup
+    phase ``sac/algorithm.py:227-228``). Shared with
+    ``scripts/tpu_train_proof.py``'s env-step accounting."""
+    return max(update_every, (start_steps // update_every) * update_every)
+
+
 def train_on_device(
     env_name: str,
     config,
@@ -381,13 +389,10 @@ def train_on_device(
         state, buffer, meta = checkpointer.restore(state, buffer)
         start_epoch = int(meta["epoch"]) + 1
 
-    warmup_steps = max(
-        config.update_every,
-        (config.start_steps // config.update_every) * config.update_every,
-    )
+    n_warmup = warmup_steps(config.start_steps, config.update_every)
     if start_epoch == 0:
         state, buffer, env_states, act_key, _ = loop.epoch(
-            state, buffer, env_states, act_key, steps=warmup_steps,
+            state, buffer, env_states, act_key, steps=n_warmup,
             update_every=config.update_every, warmup=True,
         )
 
