@@ -233,6 +233,21 @@ def test_update_burst_donates_buffer_in_hlo(sac_and_state):
     assert with_buffer - state_only >= 7, (with_buffer, state_only)
 
 
+def test_burst_unroll_auto_resolves_by_backend(monkeypatch):
+    """Default burst_unroll=0 is 'auto': 5 on the TPU backend, 1
+    elsewhere. Both branches are pinned by patching the backend probe
+    (the property reads it at call time); explicit values pass through
+    unchanged and negatives are rejected at construction."""
+    assert SACConfig().burst_unroll == 0
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert SACConfig().resolved_burst_unroll == 1
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert SACConfig().resolved_burst_unroll == 5
+    assert SACConfig(burst_unroll=3).resolved_burst_unroll == 3
+    with pytest.raises(ValueError, match="burst_unroll"):
+        SACConfig(burst_unroll=-1)
+
+
 def test_update_burst_unroll_is_semantics_preserving():
     """burst_unroll is a pure scheduling knob: the unrolled scan must
     produce exactly the same learner state and metrics as unroll=1

@@ -254,7 +254,7 @@ class SAC:
             st, metrics = self.update(st, batch, axis_name)
             return (st, buf), metrics
 
-        unroll = getattr(self.config, "burst_unroll", 1)
+        unroll = self.config.resolved_burst_unroll
         (state, buffer_state), metrics = jax.lax.scan(
             body, (state, buffer_state), xs=None, length=num_updates,
             unroll=unroll,

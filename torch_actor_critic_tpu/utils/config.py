@@ -108,8 +108,14 @@ class SACConfig:
     # lax.scan unroll factor for the fused gradient burst
     # (sac/algorithm.py update_burst). At the reference's tiny model
     # the per-step kernels are launch-bound on TPU; unrolling trades
-    # compile time and code size for less loop overhead. 1 = plain scan.
-    burst_unroll: int = 1
+    # compile time and code size for less loop overhead. 1 = plain
+    # scan; 0 = auto (5 on the TPU backend — the chip-measured best at
+    # the reference config, +12% over plain scan: burst_unroll section
+    # of runs/tpu/bench_20260731T034827Z.json — 1 elsewhere, where the
+    # gain is small and the unrolled scan body compiles ~3x slower). The knob
+    # is semantics-preserving (exact-equality pinned in
+    # tests/test_sac_update.py), so auto-tuning it is safe.
+    burst_unroll: int = 0
 
     # Step the host env batch in parallel worker processes over the
     # native shared-memory runtime (envs/vec_env.py + native/). False =
@@ -138,12 +144,27 @@ class SACConfig:
                 f"compute_dtype must be 'float32' or 'bfloat16', got "
                 f"{self.compute_dtype!r}"
             )
+        if self.burst_unroll < 0:
+            raise ValueError(
+                f"burst_unroll must be >= 0 (0 = auto), got {self.burst_unroll}"
+            )
         if self.actor_param_lag and not self.host_actor:
             raise ValueError(
                 "actor_param_lag requires host_actor=True — the "
                 "device-actor path reads post-burst params directly, so "
                 "there is no mirror to run stale."
             )
+
+    @property
+    def resolved_burst_unroll(self) -> int:
+        """``burst_unroll`` with 0 resolved by backend: 5 on TPU (the
+        chip-measured best at the reference config), 1 elsewhere. The
+        resolution happens at trace time, when the backend is known."""
+        if self.burst_unroll:
+            return self.burst_unroll
+        import jax
+
+        return 5 if jax.default_backend() == "tpu" else 1
 
     @property
     def model_dtype(self):
