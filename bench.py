@@ -485,7 +485,7 @@ def bench_attention(budget_s=180.0, t=2048, block_sweep=False):
             lambda q, k, v: q * 0.999 + 1e-3 * attention(q, k, v, causal=True)
         )
 
-        def loss_vjp_blocks(q, k, v, g, block_q=128, block_k=128):
+        def loss_vjp_blocks(q, k, v, g, block_q=None, block_k=None):
             _, vjp = jax.vjp(
                 lambda q, k, v: attention(
                     q, k, v, causal=True, block_q=block_q, block_k=block_k
@@ -543,9 +543,11 @@ def bench_attention(budget_s=180.0, t=2048, block_sweep=False):
             out["fwd_bwd_tflops_bf16"] = round(flops_bwd / dt / 1e12, 2)
 
         # Pallas block-size tuning (TPU only — the XLA path ignores
-        # block_q): fwd+bwd bf16 at a few (block_q, block_k) tilings;
-        # the default is (128, 128). Opt-in per call: each point pays a
-        # fresh Pallas fwd+bwd compile, so the caller must budget for it.
+        # block_q): fwd+bwd bf16 at a few (block_q, block_k) tilings.
+        # The un-suffixed rows above run the product default (auto
+        # blocks, 512-capped — chosen FROM this sweep's chip data).
+        # Opt-in per call: each point pays a fresh Pallas fwd+bwd
+        # compile, so the caller must budget for it.
         if block_sweep and jax.default_backend() == "tpu":
             sweep = []
             for bq, bk in ((128, 256), (256, 256), (256, 512), (512, 512)):

@@ -66,6 +66,44 @@ def test_pallas_dispatch_off_tpu_fails_loudly():
         jax.jit(lambda q, k, v: attention(q, k, v, impl="pallas"))(q, k, v)
 
 
+def test_auto_block_selection():
+    """Default (None) block sizes resolve to the largest of
+    {128, 256, 512} tiling the sequence — the chip block-sweep optimum
+    — while accepting EXACTLY the shape set the old fixed-128 default
+    did: shapes the old default sent to XLA (or rejected) must not
+    silently acquire degenerate Pallas tiles."""
+    from torch_actor_critic_tpu.ops.attention import _auto_block, _check_blocks
+
+    assert _auto_block(2048) == 512
+    assert _auto_block(8192) == 512
+    assert _auto_block(640) == 128   # 640 % 512 != 0, 640 % 128 == 0
+    assert _auto_block(64) == 64     # <= 128: one block, as before
+    # Old default rejected these (not 128-divisible, > 128): auto must
+    # too, not hand them 8-wide tiles the chip never validated.
+    assert _auto_block(264) is None
+    assert _auto_block(1032) is None
+    assert _check_blocks(1024, 640, None, None) == (512, 128)
+    with pytest.raises(ValueError, match="ragged"):
+        _check_blocks(1032, 1032, None, None)
+    # Explicit values still pass through (and still validate).
+    assert _check_blocks(1024, 1024, 128, 256) == (128, 256)
+    # The dispatcher routes auto-rejected lengths to XLA (same result,
+    # no Pallas trace — this would raise off-TPU if it tried Pallas).
+    q, k, v = qkv(9, t=264)
+    np.testing.assert_allclose(
+        attention(q, k, v, causal=True),
+        reference_attention(q, k, v, causal=True),
+        atol=1e-5,
+    )
+    # Auto equals explicit at the resolved sizes in interpret mode.
+    q, k, v = qkv(7, t=24)  # 24 <= 128 -> single (24, 24) block
+    np.testing.assert_allclose(
+        flash_attention(q, k, v, True, None, None, True),
+        flash_attention(q, k, v, True, 24, 24, True),
+        atol=1e-6,
+    )
+
+
 def test_flash_rejects_ragged_lengths():
     q, k, v = qkv(20, t=20)  # 20 % 8 != 0
     with pytest.raises(ValueError, match="ragged"):

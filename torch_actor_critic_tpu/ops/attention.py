@@ -297,13 +297,38 @@ def _pad_head_dim(*arrays: jax.Array) -> t.Tuple[jax.Array, ...]:
     )
 
 
-def _check_blocks(tq: int, tk: int, block_q: int, block_k: int):
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
-    if tq % block_q or tk % block_k:
+# Auto block-size cap: the chip's block sweep (runs/tpu/
+# bench_20260731T034827Z.json, attention.block_sweep) measured fwd+bwd
+# at [4, 8, 2048, 64] bf16 monotonically improving up to (512, 512) —
+# 16.9 TFLOP/s vs the 6.1 the old (128, 128) default recorded in the
+# same artifact, a 2.8x — so auto picks the largest block in
+# {128, 256, 512} that tiles the sequence.
+_AUTO_BLOCK_CAP = 512
+
+
+def _auto_block(t: int, cap: int = _AUTO_BLOCK_CAP) -> int | None:
+    """Largest block in {512, 256, 128} <= ``cap`` dividing ``t``
+    (``t`` itself when ``t <= 128`` — the single-block case the old
+    128 default already allowed). ``None`` when no such block exists:
+    the shape set accepted here is exactly the old fixed-128 default's
+    (so no shape silently moves from the XLA path onto never-validated
+    degenerate Pallas tiles), only the chosen block can be larger."""
+    if t <= 128:
+        return t
+    for b in (512, 256, 128):
+        if b <= cap and t % b == 0:
+            return b
+    return None
+
+
+def _check_blocks(tq: int, tk: int, block_q: int | None, block_k: int | None):
+    block_q = _auto_block(tq) if block_q is None else min(block_q, tq)
+    block_k = _auto_block(tk) if block_k is None else min(block_k, tk)
+    if block_q is None or block_k is None or tq % block_q or tk % block_k:
         raise ValueError(
             f"flash_attention: Tq={tq} must divide by block_q={block_q} and "
-            f"Tk={tk} by block_k={block_k}; use attention(impl='xla') or "
+            f"Tk={tk} by block_k={block_k} (None = no 128/256/512 block "
+            "tiles the length); use attention(impl='xla') or "
             "blockwise_attention for ragged lengths."
         )
     return block_q, block_k
@@ -605,8 +630,8 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool = False,
 ):
     """Pallas TPU flash attention, forward *and* backward kernels.
@@ -618,10 +643,14 @@ def flash_attention(
     FlashAttention-2 scheme, O(block²) VMEM, no (Tq, Tk) matrix ever
     materialized in either direction.
 
-    Requires ``Tq % block_q == 0`` and ``Tk % block_k == 0`` (raises
-    ``ValueError`` otherwise); any head dim works (zero-padded to the
-    128-lane width internally). ``interpret=True`` runs the kernels in
-    the Pallas interpreter (CPU-testable; used by the test suite).
+    ``block_q``/``block_k`` default to auto: the largest block in
+    {128, 256, 512} that tiles the sequence length — 512 is the chip's
+    block-sweep optimum (2.8x the old 128-block default fwd+bwd bf16,
+    see ``_AUTO_BLOCK_CAP``). Explicit values require ``Tq % block_q == 0``
+    and ``Tk % block_k == 0`` (raises ``ValueError`` otherwise); any
+    head dim works (zero-padded to the 128-lane width internally).
+    ``interpret=True`` runs the kernels in the Pallas interpreter
+    (CPU-testable; used by the test suite).
     """
     return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
 
@@ -649,8 +678,8 @@ def attention(
     v: jax.Array,
     causal: bool = False,
     impl: str = "auto",
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ) -> jax.Array:
     """Dispatch: ``'pallas'`` kernel on TPU-compatible shapes,
     ``'xla'`` blockwise scan otherwise; ``'auto'`` picks by the process
@@ -672,14 +701,23 @@ def attention(
         # TPU tiles are (8, 128) for f32: besides block divisibility,
         # require sublane-aligned sequence lengths (T % 8 == 0) or the
         # kernel would compile sublane-unaligned tiles that are only
-        # ever exercised in interpret mode.
+        # ever exercised in interpret mode. Auto blocks (None) accept
+        # exactly the shape set the old fixed-128 default did (see
+        # _auto_block); a None result routes to XLA like a ragged
+        # length always has.
+        bq = _auto_block(q.shape[2]) if block_q is None else block_q
+        bk = _auto_block(k.shape[2]) if block_k is None else block_k
         shapes_ok = (
-            q.shape[2] % 8 == 0
+            bq is not None
+            and bk is not None
+            and q.shape[2] % 8 == 0
             and k.shape[2] % 8 == 0
-            and q.shape[2] % min(block_q, q.shape[2]) == 0
-            and k.shape[2] % min(block_k, k.shape[2]) == 0
+            and q.shape[2] % min(bq, q.shape[2]) == 0
+            and k.shape[2] % min(bk, k.shape[2]) == 0
         )
         impl = "pallas" if (on_tpu and shapes_ok) else "xla"
     if impl == "pallas":
         return flash_attention(q, k, v, causal, block_q, block_k)
-    return blockwise_attention(q, k, v, causal, block_k=block_k)
+    return blockwise_attention(
+        q, k, v, causal, block_k=128 if block_k is None else block_k
+    )
