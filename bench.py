@@ -599,12 +599,15 @@ def bench_visual(budget_s=300.0, burst=25):
     from torch_actor_critic_tpu.buffer import init_visual_replay_buffer, push
     from torch_actor_critic_tpu.buffer.replay import estimate_buffer_bytes
     from torch_actor_critic_tpu.core.types import Batch, MultiObservation
+    from torch_actor_critic_tpu.envs.wall_runner import (
+        ACT_DIM, FEATURE_DIM, FRAME_SHAPE,
+    )
     from torch_actor_critic_tpu.models import VisualActor, VisualDoubleCritic
     from torch_actor_critic_tpu.sac import SAC
     from torch_actor_critic_tpu.utils.config import SACConfig
     from torch_actor_critic_tpu.utils.sync import drain
 
-    feat, frame, act_dim, batch = 168, (64, 64, 3), 56, 32
+    feat, frame, act_dim, batch = FEATURE_DIM, FRAME_SHAPE, ACT_DIM, 32
     capacity = 20_000
     out = {
         "geometry": {

@@ -63,6 +63,23 @@ def test_persist_then_load_round_trips(tmp_path, monkeypatch):
     assert lk["value"] == 123.4 and lk["mfu"] == 0.004
 
 
+def test_visual_bench_geometry_matches_wall_runner_spec():
+    """bench_visual's 'exact wall-runner geometry' claim (BASELINE
+    config 5): the bench imports the env module's constants (single
+    source of truth), and those constants ARE the reference's spaces
+    (ref environments/wall_runner.py:20-21) — pin both facts."""
+    import inspect
+
+    from torch_actor_critic_tpu.envs import wall_runner
+
+    src = inspect.getsource(bench.bench_visual)
+    for name in ("FEATURE_DIM", "FRAME_SHAPE", "ACT_DIM"):
+        assert name in src, f"bench_visual no longer uses {name}"
+    assert wall_runner.FEATURE_DIM == 168
+    assert wall_runner.FRAME_SHAPE == (64, 64, 3)
+    assert wall_runner.ACT_DIM == 56
+
+
 def test_capture_stage_names_exist_in_bench_registry():
     """scripts/tpu_capture.py drives stages by name; a typo would only
     surface as a chip-side diagnostic when the tunnel is up — pin the
