@@ -53,7 +53,15 @@ def smoke_flash():
         reference_attention,
     )
 
-    for causal, t, d in [(True, 256, 64), (False, 256, 64), (True, 128, 48)]:
+    # (None, None) blocks = the production auto path (512-capped; the
+    # t=1024 row resolves to 512 blocks, the chip-sweep optimum the
+    # defaults now ship) alongside an explicit-128 row.
+    for causal, t, d, bq, bk in [
+        (True, 256, 64, None, None),
+        (False, 256, 64, 128, 128),
+        (True, 128, 48, None, None),
+        (True, 1024, 64, None, None),
+    ]:
         ks = jax.random.split(jax.random.key(0), 4)
         q, k, v = (
             jax.random.normal(kk, (2, 4, t, d), jnp.float32) for kk in ks[:3]
@@ -61,7 +69,7 @@ def smoke_flash():
         g = jax.random.normal(ks[3], (2, 4, t, d), jnp.float32)
         interp = os.environ.get("TAC_SMOKE_CPU") == "1"  # CPU dry-run only
         out_f, vjp_f = jax.vjp(
-            lambda q, k, v: flash_attention(q, k, v, causal, 128, 128, interp),
+            lambda q, k, v: flash_attention(q, k, v, causal, bq, bk, interp),
             q, k, v,
         )
         out_r, vjp_r = jax.vjp(
