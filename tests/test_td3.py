@@ -1,0 +1,267 @@
+"""TD3 extension: update semantics, delay cadence, and the full loop.
+
+The reference is SAC-only; these tests pin the second algorithm family
+against the canonical TD3 semantics (Fujimoto et al. 2018) and prove it
+rides the same burst/mesh/Trainer machinery as SAC.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_actor_critic_tpu.buffer import init_replay_buffer, push
+from torch_actor_critic_tpu.core.types import Batch
+from torch_actor_critic_tpu.models import DeterministicActor, DoubleCritic
+from torch_actor_critic_tpu.td3 import TD3, losses
+from torch_actor_critic_tpu.utils.config import SACConfig
+
+OBS_DIM, ACT_DIM = 4, 2
+
+
+def make_td3(**overrides):
+    cfg = SACConfig(
+        algorithm="td3", hidden_sizes=(32, 32), batch_size=8, **overrides
+    )
+    actor = DeterministicActor(
+        act_dim=ACT_DIM, hidden_sizes=cfg.hidden_sizes,
+        act_limit=1.0, act_noise=cfg.act_noise,
+    )
+    critic = DoubleCritic(hidden_sizes=cfg.hidden_sizes, num_qs=cfg.num_qs)
+    return TD3(cfg, actor, critic, ACT_DIM)
+
+
+def make_batch(key, n=8):
+    ks = jax.random.split(key, 5)
+    return Batch(
+        states=jax.random.normal(ks[0], (n, OBS_DIM)),
+        actions=jnp.tanh(jax.random.normal(ks[1], (n, ACT_DIM))),
+        rewards=jax.random.normal(ks[2], (n,)),
+        next_states=jax.random.normal(ks[3], (n, OBS_DIM)),
+        done=jnp.zeros((n,)),
+    )
+
+
+def test_deterministic_actor_contract():
+    """Noiseless when deterministic; clipped noisy exploration
+    otherwise; key required only for exploration."""
+    actor = DeterministicActor(act_dim=ACT_DIM, hidden_sizes=(16,),
+                               act_limit=2.0, act_noise=0.3)
+    params = actor.init(jax.random.key(0), jnp.zeros((OBS_DIM,)), None,
+                        deterministic=True)
+    obs = jax.random.normal(jax.random.key(1), (5, OBS_DIM))
+    a_det, logp = actor.apply(params, obs, None, deterministic=True)
+    assert logp is None
+    assert a_det.shape == (5, ACT_DIM)
+    assert float(jnp.max(jnp.abs(a_det))) <= 2.0
+    a1 = actor.apply(params, obs, jax.random.key(2))[0]
+    a2 = actor.apply(params, obs, jax.random.key(3))[0]
+    assert float(jnp.max(jnp.abs(a1 - a_det))) > 0  # noise applied
+    assert float(jnp.max(jnp.abs(a1 - a2))) > 0     # key-dependent
+    assert float(jnp.max(jnp.abs(a1))) <= 2.0       # clipped to the box
+    with pytest.raises(ValueError, match="PRNG key"):
+        actor.apply(params, obs, None)
+
+
+def test_target_smoothing_reduces_to_deterministic_backup():
+    """With noise_clip=0 the smoothing noise vanishes: the critic loss
+    must equal the zero-target-noise one exactly."""
+    td3 = make_td3()
+    state = td3.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
+    batch = make_batch(jax.random.key(1))
+
+    def loss_with(target_noise, noise_clip):
+        loss, _ = losses.critic_loss(
+            state.critic_params,
+            actor_apply=td3._actor_apply,
+            critic_apply=td3._critic_apply,
+            target_actor_params=state.target_actor_params,
+            target_critic_params=state.target_critic_params,
+            batch=batch,
+            key=jax.random.key(2),
+            act_limit=1.0,
+            target_noise=target_noise,
+            noise_clip=noise_clip,
+            gamma=0.99,
+            reward_scale=1.0,
+        )
+        return float(loss)
+
+    assert loss_with(0.5, 0.0) == loss_with(0.0, 0.5)
+    # And with real smoothing the loss differs (noise actually flows).
+    assert loss_with(0.5, 0.5) != loss_with(0.0, 0.5)
+
+
+def test_policy_delay_cadence():
+    """With policy_delay=d: actor params, policy opt state and BOTH
+    target nets change only on every d-th update; the critic changes
+    every update."""
+    td3 = make_td3(policy_delay=3)
+    state = td3.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
+    update = jax.jit(td3.update)
+
+    def leaf0(tree):
+        return np.asarray(jax.tree_util.tree_leaves(tree)[0])
+
+    for i in range(1, 7):
+        prev = state
+        state, m = update(state, make_batch(jax.random.key(100 + i)))
+        critic_moved = not np.allclose(leaf0(prev.critic_params),
+                                       leaf0(state.critic_params))
+        actor_moved = not np.allclose(leaf0(prev.actor_params),
+                                      leaf0(state.actor_params))
+        targ_pi_moved = not np.allclose(leaf0(prev.target_actor_params),
+                                        leaf0(state.target_actor_params))
+        targ_q_moved = not np.allclose(leaf0(prev.target_critic_params),
+                                       leaf0(state.target_critic_params))
+        opt_count = int(jax.tree_util.tree_leaves(state.pi_opt_state)[0])
+        assert critic_moved
+        expected = i % 3 == 0
+        assert actor_moved == expected, i
+        assert targ_pi_moved == expected, i
+        assert targ_q_moved == expected, i
+        # Adam count advances only on applied policy updates.
+        assert opt_count == i // 3, (i, opt_count)
+
+
+def test_update_burst_runs_and_learns():
+    """The shared push-then-scan burst drives TD3: with gamma=0 the
+    critic is pure regression onto a deterministic reward function, so
+    its loss must fall over repeated bursts (with bootstrapped targets
+    the loss needn't be monotone, hence the gamma=0 construction)."""
+    td3 = make_td3(gamma=0.0)
+    state = td3.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
+    buf = init_replay_buffer(
+        512, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32), ACT_DIM
+    )
+
+    def chunk(key, n):
+        b = make_batch(key, n=n)
+        return b.replace(
+            rewards=jnp.sum(b.states, -1) + jnp.sum(b.actions, -1)
+        )
+
+    buf = push(buf, chunk(jax.random.key(5), 128))
+    burst = jax.jit(td3.update_burst, static_argnums=(3,))
+    first = None
+    for i in range(20):
+        state, buf, m = burst(state, buf, chunk(jax.random.key(10 + i), 10), 10)
+        if first is None:
+            first = float(m["loss_q"])
+    assert float(m["loss_q"]) < first
+    assert int(state.step) == 200
+
+
+def make_dp_chunk(key, n_dev, per_dev):
+    ks = jax.random.split(key, 5)
+    shape = (n_dev, per_dev)
+    return Batch(
+        states=jax.random.normal(ks[0], shape + (OBS_DIM,)),
+        actions=jnp.tanh(jax.random.normal(ks[1], shape + (ACT_DIM,))),
+        rewards=jax.random.normal(ks[2], shape),
+        next_states=jax.random.normal(ks[3], shape + (OBS_DIM,)),
+        done=jnp.zeros(shape),
+    )
+
+
+def test_td3_under_data_parallel_mesh():
+    """TD3 slots into the same mesh wrapper as SAC: a dp burst on the
+    8-virtual-device mesh runs and keeps params replicated."""
+    from torch_actor_critic_tpu.parallel import (
+        DataParallelSAC,
+        init_sharded_buffer,
+        make_mesh,
+        shard_chunk,
+    )
+
+    td3 = make_td3()
+    dp = DataParallelSAC(td3, make_mesh())
+    state = dp.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
+    buf = init_sharded_buffer(
+        128, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32), ACT_DIM, dp.mesh
+    )
+    warm = shard_chunk(make_dp_chunk(jax.random.key(1), 8, 32), dp.mesh)
+    chunk = shard_chunk(make_dp_chunk(jax.random.key(2), 8, 10), dp.mesh)
+    state, buf, _ = dp.update_burst(state, buf, warm, 1)
+    state, buf, m = dp.update_burst(state, buf, chunk, 5)
+    assert np.isfinite(float(m["loss_q"]))
+    assert int(state.step) == 6
+    leaf = jax.tree_util.tree_leaves(state.target_actor_params)[0]
+    assert leaf.sharding.is_fully_replicated
+
+
+def test_td3_trainer_end_to_end(tmp_path):
+    """Full Trainer loop on Pendulum with algorithm='td3': runs, logs
+    both losses, checkpoints (incl. target actor), resumes."""
+    from torch_actor_critic_tpu.sac.trainer import Trainer
+    from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
+
+    cfg = SACConfig(
+        algorithm="td3", epochs=1, steps_per_epoch=120, start_steps=40,
+        update_after=40, update_every=20, batch_size=16,
+        hidden_sizes=(32, 32), buffer_size=2000, max_ep_len=100,
+        save_every=1,
+    )
+    ckpt = Checkpointer(tmp_path / "ckpt")
+    tr = Trainer("Pendulum-v1", cfg, checkpointer=ckpt, seed=0)
+    metrics = tr.train()
+    assert np.isfinite(metrics["loss_q"]) and np.isfinite(metrics["loss_pi"])
+    assert int(tr.state.step) > 0
+
+    tr2 = Trainer(
+        "Pendulum-v1", cfg, checkpointer=Checkpointer(tmp_path / "ckpt"), seed=0
+    )
+    tr2.restore()
+    # The restored state carries the TD3-only target actor subtree and
+    # the trained step counter.
+    assert tr2.state.target_actor_params is not None
+    assert int(tr2.state.step) == int(tr.state.step)
+    ckpt.close()
+
+
+@pytest.mark.slow
+def test_td3_solves_pendulum():
+    """Convergence: TD3 through the product Trainer reaches the solved
+    band on Pendulum (deterministic eval; measured -132 mean over 10
+    episodes at this config on CPU — the bound is deliberately loose
+    against seed variance)."""
+    from torch_actor_critic_tpu.sac.trainer import Trainer
+
+    cfg = SACConfig(
+        algorithm="td3", epochs=6, steps_per_epoch=2500, start_steps=1000,
+        update_after=1000, update_every=50, batch_size=64, max_ep_len=200,
+    )
+    tr = Trainer("Pendulum-v1", cfg, seed=0)
+    tr.train()
+    ev = tr.evaluate(episodes=10, deterministic=True, seed=0)
+    assert ev["ep_ret_mean"] > -400, ev
+    tr.close()
+
+
+def test_td3_rejects_visual_and_sequence_stacks():
+    from torch_actor_critic_tpu.sac.trainer import build_models
+
+    class _FakeVisualEnv:
+        from torch_actor_critic_tpu.core.types import MultiObservation
+        obs_spec = MultiObservation(
+            features=jax.ShapeDtypeStruct((4,), jnp.float32),
+            frame=jax.ShapeDtypeStruct((8, 8, 3), jnp.uint8),
+        )
+        act_dim = 2
+        act_limit = 1.0
+
+    with pytest.raises(ValueError, match="flat observation"):
+        build_models(SACConfig(algorithm="td3"), _FakeVisualEnv())
+
+
+def test_config_rejects_bad_algorithm():
+    with pytest.raises(ValueError, match="algorithm"):
+        SACConfig(algorithm="ppo")
+    with pytest.raises(ValueError, match="policy_delay"):
+        SACConfig(policy_delay=0)
+    # SAC-only opt-ins must fail at construction under td3, not be
+    # silently inert (same policy as the visual/sequence stack gate).
+    with pytest.raises(ValueError, match="SAC-only"):
+        SACConfig(algorithm="td3", learn_alpha=True)
+    with pytest.raises(ValueError, match="SAC-only"):
+        SACConfig(algorithm="td3", parity_pi_obs=True)

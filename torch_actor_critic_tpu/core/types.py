@@ -83,7 +83,7 @@ class BufferState:
 
 @struct.dataclass
 class TrainState:
-    """The complete SAC learner state as one pytree.
+    """The complete actor-critic learner state as one pytree.
 
     The union of everything the reference scatters across mutable
     objects: actor/critic module params (ref ``main.py:54-97``), the
@@ -96,6 +96,11 @@ class TrainState:
     Checkpointing this one pytree with Orbax persists strictly more than
     the reference's MLflow save (which drops target critic and buffer,
     ref ``sac/algorithm.py:164-180``).
+
+    ``target_actor_params`` is ``None`` for SAC (which has no target
+    policy) and holds the TD3 extension's target actor; a ``None`` field
+    contributes no pytree leaves, so SAC states — and their checkpoints
+    — are unchanged by its existence.
     """
 
     step: jax.Array  # int32: gradient steps taken
@@ -107,6 +112,7 @@ class TrainState:
     log_alpha: jax.Array  # scalar; exp() is the entropy temperature
     alpha_opt_state: optax.OptState
     rng: jax.Array
+    target_actor_params: t.Any = None
 
 
 def tree_stack(trees: t.Sequence[t.Any]) -> t.Any:

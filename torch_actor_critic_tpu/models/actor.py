@@ -57,3 +57,47 @@ class Actor(nn.Module):
         return squashed_gaussian_sample(
             key, mu, log_std, self.act_limit, deterministic, with_logprob
         )
+
+
+class DeterministicActor(nn.Module):
+    """Deterministic tanh policy for the TD3 extension.
+
+    ``tanh(MLP(obs)) * act_limit``; when ``deterministic=False`` (env
+    interaction), zero-mean Gaussian exploration noise with std
+    ``act_noise * act_limit`` is added and the result clipped back to
+    the action box — TD3's exploration scheme (Fujimoto et al. 2018;
+    no reference counterpart, the reference is SAC-only). Returns
+    ``(action, None)``: the log-prob slot exists only to satisfy the
+    actor contract shared with the stochastic policies
+    (``apply(params, obs, key, deterministic, with_logprob)``), since a
+    deterministic policy has no density.
+    """
+
+    act_dim: int
+    hidden_sizes: t.Sequence[int] = (256, 256)
+    act_limit: float = 1.0
+    act_noise: float = 0.1
+    dtype: t.Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        obs: jax.Array,
+        key: jax.Array | None = None,
+        deterministic: bool = False,
+        with_logprob: bool = True,  # noqa: ARG002 — contract-only
+    ):
+        trunk = MLP(self.hidden_sizes, activate_final=True, dtype=self.dtype)(obs)
+        mu = Dense(self.act_dim, dtype=self.dtype)(trunk).astype(jnp.float32)
+        action = jnp.tanh(mu) * self.act_limit
+        if not deterministic:
+            if key is None:
+                raise ValueError(
+                    "DeterministicActor needs a PRNG key for exploration "
+                    "noise; pass deterministic=True for the noiseless policy"
+                )
+            noise = self.act_noise * self.act_limit * jax.random.normal(
+                key, action.shape
+            )
+            action = jnp.clip(action + noise, -self.act_limit, self.act_limit)
+        return action, None

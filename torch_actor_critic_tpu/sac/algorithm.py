@@ -244,20 +244,39 @@ class SAC:
         Metrics are averaged over the burst, mirroring the reference's
         per-epoch loss means (ref ``sac/algorithm.py:285-290``).
         """
-        buffer_state = push(buffer_state, chunk)
-
-        def body(carry, _):
-            st, buf = carry
-            rng, sample_key = jax.random.split(st.rng)
-            st = st.replace(rng=rng)
-            batch = sample(buf, sample_key, self.config.batch_size)
-            st, metrics = self.update(st, batch, axis_name)
-            return (st, buf), metrics
-
-        unroll = self.config.resolved_burst_unroll
-        (state, buffer_state), metrics = jax.lax.scan(
-            body, (state, buffer_state), xs=None, length=num_updates,
-            unroll=unroll,
+        return run_update_burst(
+            self.update, self.config, state, buffer_state, chunk,
+            num_updates, axis_name,
         )
-        metrics = jax.tree_util.tree_map(jnp.mean, metrics)
-        return state, buffer_state, metrics
+
+
+def run_update_burst(
+    update_fn: t.Callable[[TrainState, Batch, str | None],
+                          t.Tuple[TrainState, Metrics]],
+    config: SACConfig,
+    state: TrainState,
+    buffer_state: BufferState,
+    chunk: Batch,
+    num_updates: int,
+    axis_name: str | None = None,
+) -> t.Tuple[TrainState, BufferState, Metrics]:
+    """The push-then-scan burst shared by every learner (SAC here, TD3
+    in :mod:`torch_actor_critic_tpu.td3`): algorithm choice lives
+    entirely in ``update_fn``; the burst scheduling (sampling inside
+    the compiled program, scan unroll) is algorithm-independent."""
+    buffer_state = push(buffer_state, chunk)
+
+    def body(carry, _):
+        st, buf = carry
+        rng, sample_key = jax.random.split(st.rng)
+        st = st.replace(rng=rng)
+        batch = sample(buf, sample_key, config.batch_size)
+        st, metrics = update_fn(st, batch, axis_name)
+        return (st, buf), metrics
+
+    (state, buffer_state), metrics = jax.lax.scan(
+        body, (state, buffer_state), xs=None, length=num_updates,
+        unroll=config.resolved_burst_unroll,
+    )
+    metrics = jax.tree_util.tree_map(jnp.mean, metrics)
+    return state, buffer_state, metrics

@@ -63,6 +63,31 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
     replacement of the reference's env-name string dispatch
     (ref ``main.py:63-90``)."""
     dtype = config.model_dtype
+    if config.algorithm == "td3":
+        # TD3 (extension): deterministic tanh policy over the flat MLP
+        # stack. The visual/sequence stacks are squashed-Gaussian-only
+        # for now — fail at construction, not mid-training.
+        if isinstance(env.obs_spec, MultiObservation) or (
+            len(env.obs_spec.shape) != 1
+        ):
+            raise ValueError(
+                "algorithm='td3' supports flat observation vectors only "
+                f"(got obs spec {env.obs_spec}); use algorithm='sac' for "
+                "the visual and sequence stacks"
+            )
+        from torch_actor_critic_tpu.models import DeterministicActor
+
+        actor = DeterministicActor(
+            act_dim=env.act_dim,
+            hidden_sizes=config.hidden_sizes,
+            act_limit=env.act_limit,
+            act_noise=config.act_noise,
+            dtype=dtype,
+        )
+        critic = DoubleCritic(
+            hidden_sizes=config.hidden_sizes, num_qs=config.num_qs, dtype=dtype
+        )
+        return actor, critic
     if isinstance(env.obs_spec, MultiObservation):
         actor = VisualActor(
             act_dim=env.act_dim,
@@ -220,7 +245,15 @@ class Trainer:
             self.normalizer = IdentityNormalizer()
 
         actor_def, critic_def = build_models(self.config, self.pool)
-        self.sac = SAC(self.config, actor_def, critic_def, self.pool.act_dim)
+        if self.config.algorithm == "td3":
+            from torch_actor_critic_tpu.td3 import TD3
+
+            algo_cls = TD3
+        else:
+            algo_cls = SAC
+        # Kept under the historical `sac` attribute name: it is "the
+        # learner" everywhere downstream (mesh wrapper, bench, tests).
+        self.sac = algo_cls(self.config, actor_def, critic_def, self.pool.act_dim)
         self.dp = DataParallelSAC(self.sac, self.mesh)
 
         # Actor/learner split (Podracer-style): action selection runs on

@@ -1,0 +1,10 @@
+"""TD3 (Twin Delayed DDPG) — the framework's second algorithm family.
+
+Extension beyond the reference (which is SAC-only, ref
+``sac/algorithm.py``): same TrainState/replay/burst/mesh/Trainer
+machinery, selected with ``SACConfig.algorithm = "td3"`` (or
+``--algorithm td3`` on the train CLI).
+"""
+
+from torch_actor_critic_tpu.td3.algorithm import TD3  # noqa: F401
+from torch_actor_critic_tpu.td3 import losses  # noqa: F401

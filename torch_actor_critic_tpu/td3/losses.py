@@ -1,0 +1,91 @@
+"""TD3 loss functions (extension — the reference is SAC-only).
+
+Twin Delayed DDPG (Fujimoto et al. 2018) over the same pure
+(actor_apply, critic_apply) contract as
+:mod:`torch_actor_critic_tpu.sac.losses`: the critic target uses a
+smoothed target-policy action (clipped Gaussian noise on the target
+actor's output), the policy maximizes the FIRST critic head only, and
+both target networks update on the delayed-policy cadence (the delay
+itself lives in :mod:`torch_actor_critic_tpu.td3.algorithm`).
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+
+from torch_actor_critic_tpu.core.types import Batch
+
+
+def critic_loss(
+    critic_params: t.Any,
+    *,
+    actor_apply: t.Callable,
+    critic_apply: t.Callable,
+    target_actor_params: t.Any,
+    target_critic_params: t.Any,
+    batch: Batch,
+    key: jax.Array,
+    act_limit: float,
+    target_noise: float,
+    noise_clip: float,
+    gamma: float,
+    reward_scale: float,
+) -> t.Tuple[jax.Array, t.Dict[str, jax.Array]]:
+    """Twin-critic Bellman MSE with target-policy smoothing.
+
+    a' = clip(pi_targ(s') + clip(eps, +-noise_clip*act_limit),
+    +-act_limit), eps ~ N(0, (target_noise*act_limit)^2);
+    backup = reward_scale * r + gamma * (1 - done) * min_i Q_targ_i(s', a');
+    loss = sum_i mean((Q_i(s, a) - backup)^2) — the same sum-of-head-MSEs
+    shape as the SAC critic loss (and the reference's loss_q1 + loss_q2,
+    ref ``sac/algorithm.py:69-74``), with the entropy term replaced by
+    smoothing noise.
+    """
+    next_action, _ = actor_apply(
+        target_actor_params, batch.next_states, None,
+        deterministic=True, with_logprob=False,
+    )
+    noise = jnp.clip(
+        target_noise * act_limit
+        * jax.random.normal(key, next_action.shape),
+        -noise_clip * act_limit,
+        noise_clip * act_limit,
+    )
+    next_action = jnp.clip(next_action + noise, -act_limit, act_limit)
+    q_target = critic_apply(target_critic_params, batch.next_states, next_action)
+    backup = reward_scale * batch.rewards + gamma * (1.0 - batch.done) * jnp.min(
+        q_target, axis=0
+    )
+    backup = jax.lax.stop_gradient(backup)
+
+    q = critic_apply(critic_params, batch.states, batch.actions)  # (num_qs, B)
+    loss = jnp.sum(jnp.mean((q - backup[None, :]) ** 2, axis=-1))
+    aux = {"q_mean": jnp.mean(q), "backup_mean": jnp.mean(backup)}
+    return loss, aux
+
+
+def actor_loss(
+    actor_params: t.Any,
+    *,
+    actor_apply: t.Callable,
+    critic_apply: t.Callable,
+    critic_params: t.Any,
+    batch: Batch,
+) -> t.Tuple[jax.Array, t.Dict[str, jax.Array]]:
+    """Deterministic policy gradient loss: ``-mean(Q_1(s, pi(s)))``.
+
+    TD3 deliberately uses only the first critic head here (not the min
+    the SAC policy loss uses) — the twin exists to debias the BACKUP,
+    not the policy objective. Critic params are not differentiated.
+    """
+    pi, _ = actor_apply(
+        actor_params, batch.states, None,
+        deterministic=True, with_logprob=False,
+    )
+    q_pi = critic_apply(critic_params, batch.states, pi)  # (num_qs, B)
+    loss = -jnp.mean(q_pi[0])
+    aux = {"q_pi_mean": jnp.mean(q_pi[0])}
+    return loss, aux

@@ -41,6 +41,17 @@ class SACConfig:
     num_qs: int = 2  # ensemble size; 2 == reference DoubleCritic
 
     # --- extensions beyond the reference capability envelope ---
+    # Algorithm family: "sac" (the reference's algorithm, parity) or
+    # "td3" (extension — Twin Delayed DDPG over the same TrainState/
+    # replay/burst/mesh machinery, torch_actor_critic_tpu/td3/).
+    algorithm: str = "sac"
+    # TD3 hyperparameters (Fujimoto et al. 2018 defaults); ignored
+    # under algorithm="sac".
+    policy_delay: int = 2      # critic steps per policy/target update
+    act_noise: float = 0.1     # exploration noise std, x act_limit
+    target_noise: float = 0.2  # target-policy smoothing std, x act_limit
+    noise_clip: float = 0.5    # smoothing noise clip, x act_limit
+
     # Learned entropy temperature (SAC v2). The reference fixes
     # alpha=0.2; learn_alpha=False is parity mode.
     learn_alpha: bool = False
@@ -143,6 +154,23 @@ class SACConfig:
             raise ValueError(
                 f"compute_dtype must be 'float32' or 'bfloat16', got "
                 f"{self.compute_dtype!r}"
+            )
+        if self.algorithm not in ("sac", "td3"):
+            raise ValueError(
+                f"algorithm must be 'sac' or 'td3', got {self.algorithm!r}"
+            )
+        if self.policy_delay < 1:
+            raise ValueError(
+                f"policy_delay must be >= 1, got {self.policy_delay}"
+            )
+        if self.algorithm == "td3" and (self.learn_alpha or self.parity_pi_obs):
+            # Same fail-at-construction policy as the visual/sequence
+            # gate: a SAC-only opt-in silently doing nothing would let a
+            # user believe the feature is active.
+            raise ValueError(
+                "learn_alpha and parity_pi_obs are SAC-only options; "
+                "algorithm='td3' has no entropy temperature and no "
+                "pi-loss observation quirk"
             )
         if self.burst_unroll < 0:
             raise ValueError(
