@@ -254,6 +254,22 @@ def test_td3_rejects_visual_and_sequence_stacks():
         build_models(SACConfig(algorithm="td3"), _FakeVisualEnv())
 
 
+def test_ddpg_degenerate_config():
+    """DDPG is TD3's degenerate corner: policy_delay=1, target_noise=0,
+    num_qs=1 (min over one head = plain Q). Pin that the corner runs —
+    the framework gets a third classical algorithm for free."""
+    td3 = make_td3(policy_delay=1, target_noise=0.0, num_qs=1)
+    state = td3.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
+    update = jax.jit(td3.update)
+    prev = state
+    state, m = update(state, make_batch(jax.random.key(1)))
+    assert np.isfinite(float(m["loss_q"]))
+    # policy_delay=1: the actor moves on every update.
+    a0 = jax.tree_util.tree_leaves(prev.actor_params)[0]
+    a1 = jax.tree_util.tree_leaves(state.actor_params)[0]
+    assert not np.allclose(np.asarray(a0), np.asarray(a1))
+
+
 def test_config_rejects_bad_algorithm():
     with pytest.raises(ValueError, match="algorithm"):
         SACConfig(algorithm="ppo")
