@@ -257,23 +257,28 @@ def visual_flops_per_step(feat=168, frame=(64, 64, 3), act_dim=56,
 
 
 def _make_bench_fn(obs_dim, act_dim, hidden, batch, capacity=1_000_000,
-                   compute_dtype="float32", burst_unroll=0):
+                   compute_dtype="float32", burst_unroll=0,
+                   algorithm="sac"):
     import jax
     import jax.numpy as jnp
 
     from torch_actor_critic_tpu.buffer import init_replay_buffer, push
     from torch_actor_critic_tpu.core.types import Batch
-    from torch_actor_critic_tpu.models import Actor, DoubleCritic
-    from torch_actor_critic_tpu.sac import SAC
+    from torch_actor_critic_tpu.sac.trainer import build_models, make_learner
     from torch_actor_critic_tpu.utils.config import SACConfig
 
     cfg = SACConfig(
         batch_size=batch, hidden_sizes=hidden, compute_dtype=compute_dtype,
-        burst_unroll=burst_unroll,
+        burst_unroll=burst_unroll, algorithm=algorithm,
     )
-    dt = cfg.model_dtype
-    sac = SAC(cfg, Actor(act_dim=act_dim, hidden_sizes=hidden, dtype=dt),
-              DoubleCritic(hidden_sizes=hidden, dtype=dt), act_dim)
+
+    class _Spec:  # the flat-obs env surface build_models dispatches on
+        obs_spec = jax.ShapeDtypeStruct((obs_dim,), jnp.float32)
+        act_limit = 1.0
+
+    _Spec.act_dim = act_dim
+    actor, critic = build_models(cfg, _Spec)
+    sac = make_learner(cfg, actor, critic, act_dim)
     state = sac.init_state(jax.random.key(0), jnp.zeros((obs_dim,)))
     buf = init_replay_buffer(
         capacity, jax.ShapeDtypeStruct((obs_dim,), jnp.float32), act_dim
@@ -331,6 +336,15 @@ def bench_accelerator(compute_dtype="float32"):
                          compute_dtype=compute_dtype)
     run(5)  # extra warmup beyond compile
     return run(60)
+
+
+def bench_td3():
+    """TD3 fused-burst throughput at the reference config — the second
+    algorithm family (extension) through the same update_burst path as
+    the SAC headline, for a like-for-like grad-steps/s comparison."""
+    run = _make_bench_fn(OBS_DIM, ACT_DIM, HIDDEN, BATCH, algorithm="td3")
+    run(5)
+    return {"grad_steps_per_sec": round(run(60), 1), "algorithm": "td3"}
 
 
 def bench_unroll(budget_s=300.0):
@@ -1012,6 +1026,7 @@ _STAGES = {
     "headline_bf16": _stage_headline_bf16,
     "sweep": lambda: {"sweep": bench_sweep()},
     "unroll": lambda: {"burst_unroll": bench_unroll()},
+    "td3": lambda: {"td3": bench_td3()},
     "visual": lambda: {"visual": bench_visual()},
     "host_envs": lambda: {"host_envs": bench_host_envs()},
     "on_device": lambda: {"on_device": bench_on_device()},
@@ -1136,8 +1151,8 @@ def main():
         for stage, timeout_s in (
             # attention runs two lengths with 180s internal budgets
             # each; its timeout covers both plus init + compiles.
-            ("sweep", 900), ("unroll", 420), ("on_device", 540),
-            ("attention", 900),
+            ("sweep", 900), ("unroll", 420), ("td3", 420),
+            ("on_device", 540), ("attention", 900),
         ):
             res = run_stage_subprocess(
                 stage, timeout_s, diagnostics, platform=info.get("platform")

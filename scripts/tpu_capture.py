@@ -80,6 +80,7 @@ def main() -> int:
         ("headline_bf16", 600),
         ("sweep", 900),
         ("unroll", 420),
+        ("td3", 420),
         ("visual", 480),
         ("on_device", 540),
         ("attention", 900),

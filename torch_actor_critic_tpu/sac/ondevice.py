@@ -330,16 +330,12 @@ def _wrap_and_build(env_cls, config) -> t.Tuple[t.Any, SAC]:
     time a differently-built model than training uses.
     """
     from torch_actor_critic_tpu.envs.ondevice import history_env
-    from torch_actor_critic_tpu.sac.trainer import build_models
+    from torch_actor_critic_tpu.sac.trainer import build_models, make_learner
 
     if config.history_len > 1:
         env_cls = history_env(env_cls, config.history_len)
     actor, critic = build_models(config, _SpecView(env_cls))
-    if config.algorithm == "td3":
-        from torch_actor_critic_tpu.td3 import TD3
-
-        return env_cls, TD3(config, actor, critic, env_cls.act_dim)
-    return env_cls, SAC(config, actor, critic, env_cls.act_dim)
+    return env_cls, make_learner(config, actor, critic, env_cls.act_dim)
 
 
 def warmup_steps(start_steps: int, update_every: int) -> int:

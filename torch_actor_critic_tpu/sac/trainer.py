@@ -149,6 +149,18 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
     return actor, critic
 
 
+def make_learner(config: SACConfig, actor_def, critic_def, act_dim: int):
+    """The single algorithm-dispatch point: ``config.algorithm`` picks
+    the learner class over already-built module defs. Every
+    construction path (host Trainer, fused on-device loop, bench) goes
+    through here so a new algorithm family plugs in at ONE site."""
+    if config.algorithm == "td3":
+        from torch_actor_critic_tpu.td3 import TD3
+
+        return TD3(config, actor_def, critic_def, act_dim)
+    return SAC(config, actor_def, critic_def, act_dim)
+
+
 def _set_row(tree: t.Any, i: int, value: t.Any) -> None:
     jax.tree_util.tree_map(lambda dst, src: dst.__setitem__(i, src), tree, value)
 
@@ -245,15 +257,11 @@ class Trainer:
             self.normalizer = IdentityNormalizer()
 
         actor_def, critic_def = build_models(self.config, self.pool)
-        if self.config.algorithm == "td3":
-            from torch_actor_critic_tpu.td3 import TD3
-
-            algo_cls = TD3
-        else:
-            algo_cls = SAC
         # Kept under the historical `sac` attribute name: it is "the
         # learner" everywhere downstream (mesh wrapper, bench, tests).
-        self.sac = algo_cls(self.config, actor_def, critic_def, self.pool.act_dim)
+        self.sac = make_learner(
+            self.config, actor_def, critic_def, self.pool.act_dim
+        )
         self.dp = DataParallelSAC(self.sac, self.mesh)
 
         # Actor/learner split (Podracer-style): action selection runs on
