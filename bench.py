@@ -37,6 +37,7 @@ import functools
 import glob
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -75,27 +76,56 @@ def persist_tpu_artifact(out: dict, prefix: str = "bench") -> str | None:
 
 
 def load_last_known_tpu() -> dict | None:
-    """Freshest persisted chip artifact (any prefix), or None.
+    """Chip evidence merged per-key across persisted artifacts, or None.
 
-    Timestamped filenames sort chronologically; a corrupt or valueless
-    file is skipped rather than trusted.
+    Timestamped filenames sort chronologically. The freshest artifact's
+    values win key-by-key, but sections it is missing (an incremental
+    capture killed mid-run writes only its completed stages) are filled
+    from older complete artifacts instead of being lost — the merged
+    record's ``artifact`` names the freshest contributor and
+    ``merged_from`` lists every contributing file when more than one.
+    Corrupt or valueless files are skipped rather than trusted.
     """
-    paths = sorted(
-        glob.glob(os.path.join(TPU_EVIDENCE_DIR, "*.json")),
-        key=os.path.basename,
-    )
-    for p in reversed(paths):
+    def stamp(path):
+        # Order by the timestamp token, not the whole basename — with
+        # mixed prefixes (bench_*, future attention_* etc.) the prefix
+        # would otherwise dominate and stale files would win the merge.
+        m = re.search(r"(\d{8}T\d{6}Z)", os.path.basename(path))
+        return m.group(1) if m else os.path.basename(path)
+
+    recs = []
+    for p in sorted(glob.glob(os.path.join(TPU_EVIDENCE_DIR, "*.json")),
+                    key=stamp):
         try:
             with open(p) as f:
                 rec = json.load(f)
         except (OSError, json.JSONDecodeError):
             continue
-        if rec.get("value") is not None and rec.get("backend") not in (
+        if not isinstance(rec, dict):
+            continue
+        if rec.get("value") is None or rec.get("backend") in (
             None, "none", "cpu"
         ):
-            rec["artifact"] = os.path.join("runs", "tpu", os.path.basename(p))
-            return rec
-    return None
+            continue
+        recs.append((p, rec))
+    if not recs:
+        return None
+    # Only artifacts from the same device as the freshest contributor
+    # may fill in missing sections — never publish one chip's numbers
+    # under another chip's header.
+    freshest_kind = recs[-1][1].get("device_kind")
+    merged: dict = {}
+    contributors: list[str] = []
+    for p, rec in recs:  # oldest -> newest so fresher values overwrite
+        if rec.get("device_kind") != freshest_kind:
+            continue
+        rel = os.path.join("runs", "tpu", os.path.basename(p))
+        contributors.append(rel)
+        merged.update({k: v for k, v in rec.items() if v is not None})
+        merged["artifact"] = rel
+    if len(contributors) > 1:
+        merged["merged_from"] = contributors
+    return merged
 
 # Pinned fallback: reference-style torch-CPU SAC measured on this image
 # (2 threads, ref main.py:130 config) on 2026-07-29. Used for

@@ -32,13 +32,44 @@ def test_load_last_known_tpu_picks_freshest_chip_artifact(tmp_path, monkeypatch)
     _write(tmp_path, "bench_20260730T000000Z.json", {"backend": "cpu", "value": 1.0})
     _write(tmp_path, "bench_20260730T000001Z.json", "{not json")
     assert bench.load_last_known_tpu() is None
-    for stamp, v in [("20260730T010000Z", 5000.0), ("20260730T020000Z", 5800.0)]:
-        _write(tmp_path, f"bench_{stamp}.json",
-               {"backend": "axon", "value": v, "captured_utc": stamp})
+    _write(tmp_path, "bench_20260730T010000Z.json",
+           {"backend": "axon", "value": 5000.0,
+            "captured_utc": "20260730T010000Z", "sweep": [{"mfu": 0.5}]})
+    # The freshest artifact is a PARTIAL capture (killed after the
+    # headline stage): its values win, but the older artifact's sweep
+    # must survive the merge rather than vanish.
+    _write(tmp_path, "bench_20260730T020000Z.json",
+           {"backend": "axon", "value": 5800.0,
+            "captured_utc": "20260730T020000Z"})
     lk = bench.load_last_known_tpu()
     assert lk["value"] == 5800.0  # timestamped names sort chronologically
     assert lk["captured_utc"] == "20260730T020000Z"
     assert lk["artifact"] == "runs/tpu/bench_20260730T020000Z.json"
+    assert lk["sweep"] == [{"mfu": 0.5}]  # filled from the older capture
+    assert lk["merged_from"] == [
+        "runs/tpu/bench_20260730T010000Z.json",
+        "runs/tpu/bench_20260730T020000Z.json",
+    ]
+    # Non-dict JSON is skipped, not fatal (docstring contract).
+    _write(tmp_path, "bench_20260730T015000Z.json", "[1, 2]")
+    assert bench.load_last_known_tpu()["value"] == 5800.0
+    # Ordering follows the timestamp token, not the filename prefix: a
+    # NEWER artifact with a prefix sorting before "bench" must win.
+    _write(tmp_path, "attention_20260730T030000Z.json",
+           {"backend": "axon", "value": 6000.0,
+            "captured_utc": "20260730T030000Z"})
+    lk = bench.load_last_known_tpu()
+    assert lk["value"] == 6000.0
+    assert lk["artifact"] == "runs/tpu/attention_20260730T030000Z.json"
+    # A different chip's artifact may not fill sections under this
+    # chip's header: freshest is "other-chip", so only it contributes.
+    _write(tmp_path, "bench_20260730T040000Z.json",
+           {"backend": "axon", "value": 7000.0, "device_kind": "other-chip",
+            "captured_utc": "20260730T040000Z"})
+    lk = bench.load_last_known_tpu()
+    assert lk["value"] == 7000.0
+    assert "sweep" not in lk  # the old (different-device) sweep excluded
+    assert "merged_from" not in lk  # single contributor
 
 
 def test_persist_tpu_artifact_refuses_non_chip_results(tmp_path, monkeypatch):
