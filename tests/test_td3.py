@@ -238,20 +238,59 @@ def test_td3_solves_pendulum():
     tr.close()
 
 
-def test_td3_rejects_visual_and_sequence_stacks():
-    from torch_actor_critic_tpu.sac.trainer import build_models
+def test_td3_visual_stack_and_sequence_rejection():
+    """Visual TD3: build_models dispatches a DeterministicVisualActor +
+    VisualDoubleCritic on mixed observations and the learner takes a
+    gradient step; the sequence (history) stack stays SAC-only with a
+    construction-time error."""
+    from test_visual_training import FakeVisualEnv
 
-    class _FakeVisualEnv:
-        from torch_actor_critic_tpu.core.types import MultiObservation
-        obs_spec = MultiObservation(
-            features=jax.ShapeDtypeStruct((4,), jnp.float32),
-            frame=jax.ShapeDtypeStruct((8, 8, 3), jnp.uint8),
-        )
+    from torch_actor_critic_tpu.core.types import MultiObservation
+    from torch_actor_critic_tpu.models import (
+        DeterministicVisualActor,
+        VisualDoubleCritic,
+    )
+    from torch_actor_critic_tpu.sac.trainer import build_models, make_learner
+
+    cfg = SACConfig(
+        algorithm="td3", hidden_sizes=(16, 16), batch_size=4,
+        filters=(8, 16), kernel_sizes=(4, 3), strides=(2, 1),
+        normalize_pixels=True,
+    )
+    env = FakeVisualEnv()
+    actor, critic = build_models(cfg, env)
+    assert isinstance(actor, DeterministicVisualActor)
+    assert isinstance(critic, VisualDoubleCritic)
+    td3 = make_learner(cfg, actor, critic, env.act_dim)
+    example = MultiObservation(
+        features=jnp.zeros((6,)), frame=jnp.zeros((16, 16, 3), jnp.uint8)
+    )
+    state = td3.init_state(jax.random.key(0), example)
+    ks = jax.random.split(jax.random.key(1), 6)
+    n = 4
+    batch = Batch(
+        states=MultiObservation(
+            features=jax.random.normal(ks[0], (n, 6)),
+            frame=jax.random.randint(ks[1], (n, 16, 16, 3), 0, 256, jnp.uint8),
+        ),
+        actions=jnp.tanh(jax.random.normal(ks[2], (n, 3))),
+        rewards=jax.random.normal(ks[3], (n,)),
+        next_states=MultiObservation(
+            features=jax.random.normal(ks[4], (n, 6)),
+            frame=jax.random.randint(ks[5], (n, 16, 16, 3), 0, 256, jnp.uint8),
+        ),
+        done=jnp.zeros((n,)),
+    )
+    state, m = jax.jit(td3.update)(state, batch)
+    assert np.isfinite(float(m["loss_q"]))
+
+    class _HistoryEnv:
+        obs_spec = jax.ShapeDtypeStruct((8, 4), jnp.float32)
         act_dim = 2
         act_limit = 1.0
 
-    with pytest.raises(ValueError, match="flat observation"):
-        build_models(SACConfig(algorithm="td3"), _FakeVisualEnv())
+    with pytest.raises(ValueError, match="sequence"):
+        build_models(SACConfig(algorithm="td3"), _HistoryEnv())
 
 
 def test_ddpg_degenerate_config():

@@ -2,6 +2,7 @@ from torch_actor_critic_tpu.models.mlp import MLP, torch_linear_bias_init, torch
 from torch_actor_critic_tpu.models.actor import Actor, DeterministicActor  # noqa: F401
 from torch_actor_critic_tpu.models.critic import Critic, DoubleCritic  # noqa: F401
 from torch_actor_critic_tpu.models.visual import (  # noqa: F401
+    DeterministicVisualActor,
     SimpleCNN,
     VisualActor,
     VisualCritic,

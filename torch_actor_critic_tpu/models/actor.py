@@ -59,6 +59,29 @@ class Actor(nn.Module):
         )
 
 
+def clipped_noise_action(
+    mu: jax.Array,
+    act_limit: float,
+    act_noise: float,
+    key: jax.Array | None,
+    deterministic: bool,
+    module_name: str,
+):
+    """The TD3 deterministic head shared by the flat and visual actors:
+    ``tanh(mu) * act_limit``, plus clipped zero-mean Gaussian
+    exploration noise (std ``act_noise * act_limit``) when acting."""
+    action = jnp.tanh(mu) * act_limit
+    if deterministic:
+        return action
+    if key is None:
+        raise ValueError(
+            f"{module_name} needs a PRNG key for exploration noise; "
+            "pass deterministic=True for the noiseless policy"
+        )
+    noise = act_noise * act_limit * jax.random.normal(key, action.shape)
+    return jnp.clip(action + noise, -act_limit, act_limit)
+
+
 class DeterministicActor(nn.Module):
     """Deterministic tanh policy for the TD3 extension.
 
@@ -89,15 +112,8 @@ class DeterministicActor(nn.Module):
     ):
         trunk = MLP(self.hidden_sizes, activate_final=True, dtype=self.dtype)(obs)
         mu = Dense(self.act_dim, dtype=self.dtype)(trunk).astype(jnp.float32)
-        action = jnp.tanh(mu) * self.act_limit
-        if not deterministic:
-            if key is None:
-                raise ValueError(
-                    "DeterministicActor needs a PRNG key for exploration "
-                    "noise; pass deterministic=True for the noiseless policy"
-                )
-            noise = self.act_noise * self.act_limit * jax.random.normal(
-                key, action.shape
-            )
-            action = jnp.clip(action + noise, -self.act_limit, self.act_limit)
+        action = clipped_noise_action(
+            mu, self.act_limit, self.act_noise, key, deterministic,
+            type(self).__name__,
+        )
         return action, None

@@ -37,6 +37,7 @@ import numpy as np
 from flax import linen as nn
 
 from torch_actor_critic_tpu.core.types import MultiObservation
+from torch_actor_critic_tpu.models.actor import clipped_noise_action
 from torch_actor_critic_tpu.models.mlp import (
     MLP,
     Dense,
@@ -121,6 +122,24 @@ class SimpleCNN(nn.Module):
         return x
 
 
+def _visual_actor_trunk(mod, features: jax.Array, frame: jax.Array) -> jax.Array:
+    """The MLP(features) ⊕ CNN(frame) embedding shared by both actor
+    families (squashed-Gaussian and deterministic; identical attribute
+    surface). Called inside ``nn.compact`` so submodule names — incl.
+    the pinned ``visual_network`` — stay checkpoint-stable."""
+    x = MLP(mod.hidden_sizes, activate_final=True, dtype=mod.dtype)(features)
+    vision = SimpleCNN(
+        mod.filters,
+        mod.kernel_sizes,
+        mod.strides,
+        out_features=mod.cnn_features,
+        normalize_pixels=mod.normalize_pixels,
+        dtype=mod.dtype,
+        name="visual_network",
+    )(frame)
+    return jnp.concatenate([x, vision.astype(x.dtype)], axis=-1)
+
+
 class VisualActor(nn.Module):
     """Squashed-Gaussian policy over a :class:`MultiObservation`.
 
@@ -157,18 +176,7 @@ class VisualActor(nn.Module):
         if frame.ndim == 3:
             frame = frame[None]
 
-        x = MLP(self.hidden_sizes, activate_final=True, dtype=dtype)(features)
-        vision = SimpleCNN(
-            self.filters,
-            self.kernel_sizes,
-            self.strides,
-            out_features=self.cnn_features,
-            normalize_pixels=self.normalize_pixels,
-            dtype=dtype,
-            name="visual_network",
-        )(frame)
-        x = jnp.concatenate([x, vision.astype(x.dtype)], axis=-1)
-
+        x = _visual_actor_trunk(self, features, frame)
         mu = Dense(self.act_dim, dtype=dtype)(x).astype(jnp.float32)
         log_std = Dense(self.act_dim, dtype=dtype)(x).astype(jnp.float32)
         action, logprob = squashed_gaussian_sample(
@@ -179,6 +187,52 @@ class VisualActor(nn.Module):
             if logprob is not None:
                 logprob = jnp.squeeze(logprob, axis=0)
         return action, logprob
+
+
+class DeterministicVisualActor(nn.Module):
+    """Deterministic tanh policy over a :class:`MultiObservation` —
+    the visual-stack actor for the TD3 extension (the reference has no
+    TD3 and no visual deterministic policy; this mirrors
+    :class:`VisualActor`'s trunk exactly — MLP(features) ⊕ CNN(frame)
+    concat — with the single tanh head and clipped exploration noise of
+    :class:`~torch_actor_critic_tpu.models.actor.DeterministicActor`).
+    """
+
+    act_dim: int
+    hidden_sizes: t.Sequence[int] = (256, 256)
+    act_limit: float = 1.0
+    act_noise: float = 0.1
+    filters: t.Sequence[int] = (32, 64, 64)
+    kernel_sizes: t.Sequence[int] = (8, 4, 3)
+    strides: t.Sequence[int] = (4, 2, 1)
+    cnn_features: int = 1
+    normalize_pixels: bool = False
+    dtype: t.Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        obs: MultiObservation,
+        key: jax.Array | None = None,
+        deterministic: bool = False,
+        with_logprob: bool = True,  # noqa: ARG002 — contract-only
+    ):
+        features, frame = obs.features, obs.frame
+        unbatched = features.ndim == 1
+        if unbatched:
+            features = features[None]
+        if frame.ndim == 3:
+            frame = frame[None]
+
+        x = _visual_actor_trunk(self, features, frame)
+        mu = Dense(self.act_dim, dtype=self.dtype)(x).astype(jnp.float32)
+        action = clipped_noise_action(
+            mu, self.act_limit, self.act_noise, key, deterministic,
+            type(self).__name__,
+        )
+        if unbatched:
+            action = jnp.squeeze(action, axis=0)
+        return action, None
 
 
 class VisualCritic(nn.Module):

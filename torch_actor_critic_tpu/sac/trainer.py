@@ -65,15 +65,40 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
     dtype = config.model_dtype
     if config.algorithm == "td3":
         # TD3 (extension): deterministic tanh policy over the flat MLP
-        # stack. The visual/sequence stacks are squashed-Gaussian-only
-        # for now — fail at construction, not mid-training.
-        if isinstance(env.obs_spec, MultiObservation) or (
-            len(env.obs_spec.shape) != 1
-        ):
+        # or visual stack (same twin critics as SAC). The sequence
+        # stack is squashed-Gaussian-only for now — fail at
+        # construction, not mid-training.
+        if isinstance(env.obs_spec, MultiObservation):
+            from torch_actor_critic_tpu.models import DeterministicVisualActor
+
+            actor = DeterministicVisualActor(
+                act_dim=env.act_dim,
+                hidden_sizes=config.hidden_sizes,
+                act_limit=env.act_limit,
+                act_noise=config.act_noise,
+                filters=config.filters,
+                kernel_sizes=config.kernel_sizes,
+                strides=config.strides,
+                cnn_features=config.cnn_features,
+                normalize_pixels=config.normalize_pixels,
+                dtype=dtype,
+            )
+            critic = VisualDoubleCritic(
+                hidden_sizes=config.hidden_sizes,
+                filters=config.filters,
+                kernel_sizes=config.kernel_sizes,
+                strides=config.strides,
+                cnn_features=config.cnn_features,
+                normalize_pixels=config.normalize_pixels,
+                num_qs=config.num_qs,
+                dtype=dtype,
+            )
+            return actor, critic
+        if len(env.obs_spec.shape) != 1:
             raise ValueError(
-                "algorithm='td3' supports flat observation vectors only "
+                "algorithm='td3' supports flat and visual observations "
                 f"(got obs spec {env.obs_spec}); use algorithm='sac' for "
-                "the visual and sequence stacks"
+                "the sequence (history) stack"
             )
         from torch_actor_critic_tpu.models import DeterministicActor
 
