@@ -216,6 +216,16 @@ def test_td3_trainer_end_to_end(tmp_path):
     # the trained step counter.
     assert tr2.state.target_actor_params is not None
     assert int(tr2.state.step) == int(tr.state.step)
+
+    # Cross-algorithm restore fails with a clear message BEFORE the
+    # array restore (a SAC trainer lacks the target-actor subtree).
+    sac_cfg = cfg.replace(algorithm="sac")
+    tr3 = Trainer(
+        "Pendulum-v1", sac_cfg,
+        checkpointer=Checkpointer(tmp_path / "ckpt"), seed=0,
+    )
+    with pytest.raises(ValueError, match="algorithm='td3'"):
+        tr3.restore()
     ckpt.close()
 
 

@@ -638,10 +638,26 @@ class Trainer:
         mesh)."""
         if self.checkpointer is None:
             raise ValueError("no checkpointer configured")
+        # Validate the algorithm family from metadata BEFORE the array
+        # restore: a TD3 state has a target-actor subtree a SAC trainer
+        # lacks (and vice versa), which would otherwise surface as an
+        # opaque Orbax tree-structure error. The probe is reused by the
+        # restore below (no second metadata round-trip).
+        meta_probe = self.checkpointer.peek_meta(epoch)
+        if meta_probe.get("config"):
+            saved_algo = SACConfig.from_json(meta_probe["config"]).algorithm
+            if saved_algo != self.config.algorithm:
+                raise ValueError(
+                    f"checkpoint was written by algorithm={saved_algo!r} "
+                    f"but this trainer is configured for "
+                    f"{self.config.algorithm!r}; pass --algorithm "
+                    f"{saved_algo} to resume it"
+                )
         state, buffer, meta = self.checkpointer.restore(
             jax.tree_util.tree_map(lambda x: x, self.state),
             self.buffer if include_buffer else None,
             epoch=epoch,
+            meta_probe=meta_probe,
         )
         self.state = state
         self._host_params = None  # mirror is stale

@@ -73,17 +73,35 @@ class Checkpointer:
     def latest_epoch(self) -> int | None:
         return self._mgr.latest_step()
 
+    def peek_meta(self, epoch: int | None = None) -> dict:
+        """The checkpoint's JSON metadata alone (no array restore) —
+        lets callers validate compatibility (e.g. which algorithm wrote
+        it) BEFORE a tree-structure mismatch surfaces as an opaque
+        Orbax error."""
+        epoch = epoch if epoch is not None else self._mgr.latest_step()
+        if epoch is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        return dict(
+            self._mgr.restore(
+                epoch, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+            )["meta"]
+        )
+
     def restore(
         self,
         abstract_train_state: TrainState,
         abstract_buffer: BufferState | None = None,
         epoch: int | None = None,
+        meta_probe: dict | None = None,
     ) -> t.Tuple[TrainState, BufferState | None, dict]:
         """Restore ``(train_state, buffer_state, meta)``.
 
         Abstract pytrees come from ``jax.eval_shape`` over the init
         functions (plus shardings); buffer restore is skipped if the
-        checkpoint has none.
+        checkpoint has none. A caller that already ran
+        :meth:`peek_meta` (for its own compatibility checks) can pass
+        the result as ``meta_probe`` to skip the redundant metadata
+        round-trip.
         """
         epoch = epoch if epoch is not None else self._mgr.latest_step()
         if epoch is None:
@@ -91,11 +109,8 @@ class Checkpointer:
         # Check the format version BEFORE the array restore, so a layout
         # change surfaces as this message instead of an opaque Orbax
         # tree-structure mismatch.
-        meta_probe = dict(
-            self._mgr.restore(
-                epoch, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
-            )["meta"]
-        )
+        if meta_probe is None:
+            meta_probe = self.peek_meta(epoch)
         found = int(meta_probe.get("ckpt_format", 1))
         if found != CKPT_FORMAT:
             raise ValueError(
