@@ -61,7 +61,12 @@ TPU_EVIDENCE_DIR = os.path.join(
 def persist_tpu_artifact(out: dict, prefix: str = "bench") -> str | None:
     """Write a timestamped JSON snapshot of a real-accelerator result
     into ``runs/tpu/`` (committed to the repo, unlike /tmp)."""
-    if out.get("backend") in (None, "none", "cpu") or out.get("value") is None:
+    # Gate on the backend only: a partial capture (or a future
+    # section-only artifact, e.g. attention_*/td3-only) carries real
+    # chip sections worth keeping even when the headline stage never
+    # ran — load_last_known_tpu() merges those per-key and requires a
+    # headline only of the merged result.
+    if out.get("backend") in (None, "none", "cpu"):
         return None
     os.makedirs(TPU_EVIDENCE_DIR, exist_ok=True)
     stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
@@ -69,6 +74,13 @@ def persist_tpu_artifact(out: dict, prefix: str = "bench") -> str | None:
     record = dict(out)
     record["captured_utc"] = stamp
     record.pop("diagnostics", None)  # transient; keeps artifacts stable
+    record.pop("error", None)  # run status, not evidence — a stale
+    # error merged under a fresh headline would contradict itself
+    metadata = {"backend", "device_kind", "captured_utc", "metric",
+                "unit", "notes"}
+    if not any(k for k, v in record.items()
+               if k not in metadata and v is not None):
+        return None  # nothing measured: no headline, no sections
     with open(path, "w") as f:
         json.dump(record, f, indent=1, sort_keys=True)
     log(f"persisted chip artifact: {path}")
@@ -103,10 +115,15 @@ def load_last_known_tpu() -> dict | None:
             continue
         if not isinstance(rec, dict):
             continue
-        if rec.get("value") is None or rec.get("backend") in (
-            None, "none", "cpu"
-        ):
-            continue
+        if rec.get("backend") in (None, "none", "cpu"):
+            continue  # CPU/backend-less records never carry chip evidence
+        if "metric" not in rec:
+            continue  # not a bench-family record (e.g. train_proof_*):
+            # different schema; merging its keys would pollute the record
+        # No "value" gate here: a section-only artifact (partial
+        # capture, attention_*/td3-only record) still contributes its
+        # sections to the merge; only the MERGED record must end up
+        # with a headline (checked below).
         recs.append((p, rec))
     if not recs:
         return None
@@ -122,9 +139,18 @@ def load_last_known_tpu() -> dict | None:
         rel = os.path.join("runs", "tpu", os.path.basename(p))
         contributors.append(rel)
         merged.update({k: v for k, v in rec.items() if v is not None})
-        merged["artifact"] = rel
+        if rec.get("value") is not None:
+            # "artifact" is the provenance of the HEADLINE number: the
+            # freshest record that actually carries one (a fresher
+            # section-only artifact may still win other keys above).
+            merged["artifact"] = rel
     if len(contributors) > 1:
         merged["merged_from"] = contributors
+    # A merged record that still has no headline number (every
+    # contributor was a section-only artifact) cannot stand in for a
+    # chip benchmark result.
+    if merged.get("value") is None:
+        return None
     return merged
 
 # Pinned fallback: reference-style torch-CPU SAC measured on this image

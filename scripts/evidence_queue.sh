@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Serial evidence-run queue for the 1-core sandbox.
+#
+# Consumes preset names (one per line) from runs/evidence_queue.txt,
+# running each through scripts/evidence_run.py on the CPU backend and
+# committing the artifacts as they land.  Lines may be appended while
+# the queue is running; the queue exits when the file is empty.
+# Start with:
+#   nohup bash scripts/evidence_queue.sh >> runs/evidence_queue.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+QUEUE=runs/evidence_queue.txt
+export JAX_PLATFORMS=cpu
+
+while true; do
+    next=$(head -n 1 "$QUEUE" 2>/dev/null || true)
+    if [ -z "${next:-}" ]; then
+        echo "[evidence_queue] queue empty; exiting at $(date -u +%FT%TZ)"
+        break
+    fi
+    # Never contend with a chip capture: its torch-CPU baseline stage
+    # is wall-clock-timed on this same core, and a concurrent evidence
+    # run would inflate the vs_baseline ratio.
+    while pgrep -f "tpu_capture.py|tpu_smoke.py|tpu_train_proof.py" >/dev/null; do
+        echo "[evidence_queue] chip capture in flight; waiting 60s"
+        sleep 60
+    done
+    # Consume the line before running so a crash doesn't loop forever.
+    tail -n +2 "$QUEUE" > "$QUEUE.tmp" && mv "$QUEUE.tmp" "$QUEUE"
+    echo "[evidence_queue] running $next at $(date -u +%FT%TZ)"
+    if python scripts/evidence_run.py "$next"; then
+        git add "runs/$next" 2>/dev/null
+        git commit -q -m "Commit regenerated evidence run: $next" \
+            -- "runs/$next" 2>/dev/null \
+            && echo "[evidence_queue] committed runs/$next"
+    else
+        echo "[evidence_queue] PRESET FAILED: $next (continuing)"
+    fi
+done

@@ -1,0 +1,188 @@
+"""Regenerate the committed evidence runs that PARITY.md cites.
+
+Round 3 quoted bf16 / sequence / TD3 / wall-runner results whose run
+directories were lost to the ``runs/*`` gitignore (only ``runs/tpu/``
+was whitelisted).  This script re-runs each cited configuration as a
+named preset and writes its artifacts to ``runs/<preset>/<run_id>/``
+(metrics.jsonl + params.json + summary.json), which .gitignore now
+whitelists so every number in PARITY.md maps to a tracked file.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/evidence_run.py bf16flat
+    python scripts/evidence_run.py --list
+
+Each preset is the exact configuration PARITY.md describes (the torch
+side of those comparisons lives in ``runs_parity/`` and is unchanged).
+The summary line records deterministic-eval stats over 10 episodes —
+the reference's eval protocol (ref ``run_agent.py:19-48``) — plus wall
+time, so the regenerated numbers supersede the round-3 quotes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _preset(env, seed=0, eval_episodes=10, **overrides):
+    return {"env": env, "seed": seed, "eval_episodes": eval_episodes,
+            "overrides": overrides}
+
+
+# Step budgets follow PARITY.md's quoted configurations: 16k-step
+# Pendulum for the bf16/sequence points, the reference HalfCheetah
+# budgets (100k/300k/1M) for the algorithm-level numbers, and the
+# round-3 wall-runner epoch geometry.
+PRESETS = {
+    # bf16 learning preservation, flat MLP (PARITY.md "Mixed precision")
+    "bf16flat": _preset(
+        "Pendulum-v1", epochs=4, steps_per_epoch=4000, max_ep_len=1000,
+        buffer_size=16_000, compute_dtype="bfloat16",
+    ),
+    # bf16 through the history-8 causal transformer
+    "bf16seq": _preset(
+        "Pendulum-v1", epochs=4, steps_per_epoch=4000, max_ep_len=1000,
+        buffer_size=16_000, compute_dtype="bfloat16",
+        history_len=8, seq_d_model=48, seq_num_layers=1,
+    ),
+    # f32 sequence-policy convergence (PARITY.md "Sequence-policy
+    # convergence")
+    "seqparity": _preset(
+        "Pendulum-v1", epochs=4, steps_per_epoch=4000, max_ep_len=1000,
+        buffer_size=16_000,
+        history_len=8, seq_d_model=48, seq_num_layers=1,
+    ),
+    # bf16 at the full HalfCheetah parity budget
+    "bf16cheetah": _preset(
+        "HalfCheetah-v5", epochs=20, steps_per_epoch=5000, max_ep_len=1000,
+        buffer_size=100_000, compute_dtype="bfloat16",
+    ),
+    # TD3 at the reference budgets (--algorithm td3 with the TD3
+    # paper's warmup: 10k random-action steps, updates from 1k — the
+    # round-3 configuration; Fujimoto et al. 2018 table 3).
+    "td3cheetah100k": _preset(
+        "HalfCheetah-v5", epochs=20, steps_per_epoch=5000, max_ep_len=1000,
+        buffer_size=100_000, algorithm="td3",
+        start_steps=10_000, update_after=1000,
+    ),
+    "td3cheetah100k-s1": _preset(
+        "HalfCheetah-v5", seed=1, epochs=20, steps_per_epoch=5000,
+        max_ep_len=1000, buffer_size=100_000, algorithm="td3",
+        start_steps=10_000, update_after=1000,
+    ),
+    "td3cheetah300k": _preset(
+        "HalfCheetah-v5", epochs=60, steps_per_epoch=5000, max_ep_len=1000,
+        buffer_size=300_000, algorithm="td3",
+        start_steps=10_000, update_after=1000,
+    ),
+    "td3cheetah1M": _preset(
+        "HalfCheetah-v5", epochs=200, steps_per_epoch=5000, max_ep_len=1000,
+        buffer_size=1_000_000, algorithm="td3",
+        start_steps=10_000, update_after=1000,
+    ),
+    "td3cheetah1M-s1": _preset(
+        "HalfCheetah-v5", seed=1, epochs=200, steps_per_epoch=5000,
+        max_ep_len=1000, buffer_size=1_000_000, algorithm="td3",
+        start_steps=10_000, update_after=1000,
+    ),
+    # Pixel-learning proof (VERDICT r3 #1): visual SAC on the honest
+    # pixel task, at the reference's scalar-vision parity bottleneck
+    # (cnn_features=1, unnormalized uint8 — ref convolutional.py:46-49)
+    # and at the widened extension. Conv geometry sized for the 32x32
+    # frames the same way the Atari defaults size 64x64.
+    "pixelpend-parity": _preset(
+        "PixelPendulum-v0", epochs=8, steps_per_epoch=4000, max_ep_len=1000,
+        buffer_size=32_000,
+        filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
+        cnn_dense_size=128, cnn_features=1, normalize_pixels=False,
+    ),
+    "pixelpend-wide": _preset(
+        "PixelPendulum-v0", epochs=8, steps_per_epoch=4000, max_ep_len=1000,
+        buffer_size=32_000,
+        filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
+        cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
+    ),
+    # Real composer wall-runner epoch (PARITY.md "Pixel wall-runner
+    # end-to-end"; BASELINE config 5 geometry)
+    "wallrunner-real": _preset(
+        "DeepMindWallRunner-v0", eval_episodes=2,
+        epochs=1, steps_per_epoch=600, start_steps=300, update_after=300,
+        update_every=50, batch_size=32, buffer_size=600,
+    ),
+}
+
+
+def run_preset(name: str) -> dict:
+    import jax
+
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        # Honor JAX_PLATFORMS=cpu even when a sitecustomize hook
+        # re-registers an accelerator platform over it (same
+        # countermeasure as bench.py).
+        jax.config.update("jax_platforms", "cpu")
+
+    from torch_actor_critic_tpu.parallel import make_mesh
+    from torch_actor_critic_tpu.sac.trainer import Trainer
+    from torch_actor_critic_tpu.utils.config import SACConfig
+    from torch_actor_critic_tpu.utils.tracking import Tracker
+
+    spec = PRESETS[name]
+    cfg = SACConfig(**spec["overrides"])
+    seed = spec["seed"]
+    # Re-running a preset replaces its artifacts (metrics.jsonl is
+    # append-mode; a stale run must not bleed into the fresh curve).
+    import shutil
+
+    shutil.rmtree(os.path.join("runs", name, f"s{seed}"), ignore_errors=True)
+    tracker = Tracker(experiment=name, run_id=f"s{seed}", root="runs")
+    tracker.log_params(dataclasses.asdict(cfg))
+    t0 = time.time()
+    tr = Trainer(
+        spec["env"], cfg, mesh=make_mesh(dp=1), tracker=tracker, seed=seed
+    )
+    metrics = tr.train()
+    ev = tr.evaluate(
+        episodes=spec["eval_episodes"], deterministic=True, seed=seed + 12345
+    )
+    summary = {
+        "preset": name,
+        "env": spec["env"],
+        "seed": seed,
+        "steps": cfg.epochs * cfg.steps_per_epoch,
+        "algorithm": cfg.algorithm,
+        "compute_dtype": cfg.compute_dtype,
+        "history_len": cfg.history_len,
+        "train_return_final_epoch": metrics.get("reward"),
+        "eval_return_mean": ev["ep_ret_mean"],
+        "eval_return_std": ev["ep_ret_std"],
+        "eval_ep_len_mean": ev["ep_len_mean"],
+        "eval_episodes": spec["eval_episodes"],
+        "wall_s": round(time.time() - t0, 1),
+    }
+    with open(tracker.run_dir / "summary.json", "w") as f:
+        json.dump(summary, f, indent=2)
+    tr.close()
+    print(json.dumps(summary), flush=True)
+    return summary
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("preset", nargs="?", choices=sorted(PRESETS))
+    p.add_argument("--list", action="store_true")
+    args = p.parse_args()
+    if args.list or args.preset is None:
+        print("\n".join(sorted(PRESETS)))
+        return
+    run_preset(args.preset)
+
+
+if __name__ == "__main__":
+    main()

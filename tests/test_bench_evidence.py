@@ -33,13 +33,13 @@ def test_load_last_known_tpu_picks_freshest_chip_artifact(tmp_path, monkeypatch)
     _write(tmp_path, "bench_20260730T000001Z.json", "{not json")
     assert bench.load_last_known_tpu() is None
     _write(tmp_path, "bench_20260730T010000Z.json",
-           {"backend": "axon", "value": 5000.0,
+           {"backend": "axon", "metric": "sac_grad_steps_per_sec", "value": 5000.0,
             "captured_utc": "20260730T010000Z", "sweep": [{"mfu": 0.5}]})
     # The freshest artifact is a PARTIAL capture (killed after the
     # headline stage): its values win, but the older artifact's sweep
     # must survive the merge rather than vanish.
     _write(tmp_path, "bench_20260730T020000Z.json",
-           {"backend": "axon", "value": 5800.0,
+           {"backend": "axon", "metric": "sac_grad_steps_per_sec", "value": 5800.0,
             "captured_utc": "20260730T020000Z"})
     lk = bench.load_last_known_tpu()
     assert lk["value"] == 5800.0  # timestamped names sort chronologically
@@ -56,7 +56,7 @@ def test_load_last_known_tpu_picks_freshest_chip_artifact(tmp_path, monkeypatch)
     # Ordering follows the timestamp token, not the filename prefix: a
     # NEWER artifact with a prefix sorting before "bench" must win.
     _write(tmp_path, "attention_20260730T030000Z.json",
-           {"backend": "axon", "value": 6000.0,
+           {"backend": "axon", "metric": "sac_grad_steps_per_sec", "value": 6000.0,
             "captured_utc": "20260730T030000Z"})
     lk = bench.load_last_known_tpu()
     assert lk["value"] == 6000.0
@@ -64,7 +64,7 @@ def test_load_last_known_tpu_picks_freshest_chip_artifact(tmp_path, monkeypatch)
     # A different chip's artifact may not fill sections under this
     # chip's header: freshest is "other-chip", so only it contributes.
     _write(tmp_path, "bench_20260730T040000Z.json",
-           {"backend": "axon", "value": 7000.0, "device_kind": "other-chip",
+           {"backend": "axon", "metric": "sac_grad_steps_per_sec", "value": 7000.0, "device_kind": "other-chip",
             "captured_utc": "20260730T040000Z"})
     lk = bench.load_last_known_tpu()
     assert lk["value"] == 7000.0
@@ -76,14 +76,48 @@ def test_persist_tpu_artifact_refuses_non_chip_results(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "TPU_EVIDENCE_DIR", str(tmp_path))
     assert bench.persist_tpu_artifact({"backend": "cpu", "value": 1.0}) is None
     assert bench.persist_tpu_artifact({"backend": "none", "value": 1.0}) is None
-    assert bench.persist_tpu_artifact({"backend": "axon", "value": None}) is None
     assert os.listdir(tmp_path) == []
+    # A headline-less chip record IS persisted (it carries sections a
+    # partial/section-only capture measured on the real device).
+    assert bench.persist_tpu_artifact(
+        {"backend": "axon", "metric": "sac_grad_steps_per_sec", "value": None, "attention": {"tflops": 17.0}}
+    ) is not None
+    assert len(os.listdir(tmp_path)) == 1
+
+
+def test_section_only_artifacts_contribute_without_headline(tmp_path, monkeypatch):
+    """ADVICE r3: a capture killed before (or never running) the
+    headline stage must still feed its completed sections into the
+    merge; the merged record needs a headline from SOME contributor."""
+    monkeypatch.setattr(bench, "TPU_EVIDENCE_DIR", str(tmp_path))
+    # Only section-only artifacts -> no headline anywhere -> no merge.
+    _write(tmp_path, "attention_20260731T010000Z.json",
+           {"backend": "axon", "metric": "sac_grad_steps_per_sec", "attention": {"tflops": 17.0}})
+    assert bench.load_last_known_tpu() is None
+    # A full capture appears (older than the section-only artifact):
+    # headline comes from it, the fresher section still wins per-key.
+    _write(tmp_path, "bench_20260731T000000Z.json",
+           {"backend": "axon", "metric": "sac_grad_steps_per_sec", "value": 5000.0,
+            "attention": {"tflops": 6.0}})
+    lk = bench.load_last_known_tpu()
+    assert lk["value"] == 5000.0
+    assert lk["attention"] == {"tflops": 17.0}
+    # "artifact" is headline provenance: the record that SUPPLIED the
+    # value, not the (fresher) section-only contributor.
+    assert lk["artifact"] == "runs/tpu/bench_20260731T000000Z.json"
+    assert "runs/tpu/attention_20260731T010000Z.json" in lk["merged_from"]
+    # A train-proof record (different schema, no "metric") must not
+    # pollute the merge even though its backend is the chip.
+    _write(tmp_path, "train_proof_20260731T020000Z.json",
+           {"backend": "axon", "proof": {"solved": True}, "env": "Pendulum"})
+    lk = bench.load_last_known_tpu()
+    assert "proof" not in lk and "env" not in lk
 
 
 def test_persist_then_load_round_trips(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "TPU_EVIDENCE_DIR", str(tmp_path))
     path = bench.persist_tpu_artifact(
-        {"backend": "axon", "value": 123.4, "mfu": 0.004,
+        {"backend": "axon", "metric": "sac_grad_steps_per_sec", "value": 123.4, "mfu": 0.004,
          "diagnostics": [{"transient": True}]}
     )
     rec = json.load(open(path))

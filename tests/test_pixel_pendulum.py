@@ -1,0 +1,101 @@
+"""PixelPendulum-v0 — the committed pixel-learning task (VERDICT r3 #1).
+
+Pins the honesty contract (the observation contains no scalar state:
+pixels + previous action only; velocity is observable from the
+two-rod-channel frame) and the env's protocol/registry wiring. The
+learning-curve evidence itself lives in ``runs/pixelpend-*`` (generated
+by ``scripts/evidence_run.py``); these tests keep the task honest and
+runnable.
+"""
+
+import numpy as np
+import pytest
+
+from torch_actor_critic_tpu.core.types import MultiObservation
+from torch_actor_critic_tpu.envs.pixel_pendulum import SIZE, PixelPendulum, render_rod
+from torch_actor_critic_tpu.envs.wrappers import is_visual_env, make_env
+
+
+def test_registry_and_visual_dispatch():
+    env = make_env("PixelPendulum-v0", seed=0)
+    assert isinstance(env, PixelPendulum)
+    assert is_visual_env("PixelPendulum-v0")
+    env.close()
+
+
+def test_render_rod_is_angle_sensitive():
+    a, b, c = render_rod(0.0), render_rod(1.5), render_rod(np.pi)
+    for img in (a, b, c):
+        assert img.dtype == np.uint8
+        assert (img > 0).sum() > 10  # the rod is actually drawn
+    assert (a != b).any() and (a != c).any() and (b != c).any()
+    # theta and theta+2pi are the same physical pose, identical frame
+    np.testing.assert_array_equal(render_rod(0.5), render_rod(0.5 + 2 * np.pi))
+
+
+def test_observation_contains_no_scalar_state():
+    """features carries ONLY the previous action — never angle or
+    velocity; pixels are the only state channel."""
+    env = PixelPendulum(seed=0)
+    o = env.reset(seed=0)
+    assert isinstance(o, MultiObservation)
+    assert o.features.shape == (env.act_dim,)
+    np.testing.assert_array_equal(o.features, 0.0)  # no action yet
+    assert o.frame.shape == (SIZE, SIZE, 3) and o.frame.dtype == np.uint8
+    # At reset there is no motion: both rod channels coincide.
+    np.testing.assert_array_equal(o.frame[..., 0], o.frame[..., 1])
+
+    a = np.array([1.7], np.float32)
+    o2, r, term, trunc = env.step(a)
+    np.testing.assert_array_equal(o2.features, a)  # exactly the action
+    assert np.isfinite(r) and not term
+    env.close()
+
+
+def test_velocity_is_observable_from_one_frame():
+    """Channel 0 holds the previous rod, channel 1 the current one —
+    once the pendulum moves, the channels differ (without this the task
+    would be partially observed: velocity aliasing, not vision)."""
+    env = PixelPendulum(seed=0)
+    env.reset(seed=0)
+    moved = False
+    for _ in range(5):
+        o, *_ = env.step(np.array([2.0], np.float32))
+        moved = moved or (o.frame[..., 0] != o.frame[..., 1]).any()
+    assert moved
+    env.close()
+
+
+def test_seeded_resets_are_reproducible():
+    e1, e2 = PixelPendulum(seed=0), PixelPendulum(seed=0)
+    o1, o2 = e1.reset(seed=7), e2.reset(seed=7)
+    np.testing.assert_array_equal(o1.frame, o2.frame)
+    a = np.array([0.5], np.float32)
+    (n1, r1, *_), (n2, r2, *_) = e1.step(a), e2.step(a)
+    assert r1 == r2
+    np.testing.assert_array_equal(n1.frame, n2.frame)
+    e1.close()
+    e2.close()
+
+
+@pytest.mark.slow
+def test_pixel_pendulum_trains_through_visual_stack():
+    """End-to-end smoke at the evidence-run geometry (tiny budget):
+    the product trainer consumes PixelPendulum through the visual
+    model/replay stack and produces finite losses."""
+    from torch_actor_critic_tpu.parallel import make_mesh
+    from torch_actor_critic_tpu.sac.trainer import Trainer
+    from torch_actor_critic_tpu.utils.config import SACConfig
+
+    cfg = SACConfig(
+        epochs=1, steps_per_epoch=60, start_steps=20, update_after=20,
+        update_every=20, batch_size=16, buffer_size=500, max_ep_len=200,
+        filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
+        cnn_dense_size=64, cnn_features=8, normalize_pixels=True,
+    )
+    tr = Trainer("PixelPendulum-v0", cfg, mesh=make_mesh(dp=1), seed=0)
+    m = tr.train()
+    assert int(tr.state.step) > 0
+    assert np.isfinite(m["loss_q"]) and np.isfinite(m["loss_pi"])
+    assert tr.buffer.data.states.frame.dtype == np.uint8
+    tr.close()

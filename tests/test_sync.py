@@ -1,6 +1,20 @@
 """utils/sync.drain: the host-fetch execution barrier used by all
 timing sites (see torch_actor_critic_tpu/utils/sync.py for why
-block_until_ready is not sufficient on the tunneled axon backend)."""
+block_until_ready is not sufficient on the tunneled axon backend).
+
+The second half of this file stubs the failure mode itself: a backend
+whose ``block_until_ready`` is an *event signal* that can fire before
+the queued work executes (observed on the axon tunnel as a physically
+impossible 878 TFLOP/s reading). Against that backend, ``drain`` must
+still force execution — because it demands the *value* (bytes that
+cannot exist before the producer ran), not the event.
+"""
+
+import types
+
+import numpy as np
+
+
 def test_drain_is_a_true_barrier():
     """drain() returns the reduced value, forcing producer execution."""
     import jax.numpy as jnp
@@ -11,3 +25,92 @@ def test_drain_is_a_true_barrier():
     assert drain(x) == 28.0
     assert drain(jnp.float32(3.5)) == 3.5
     assert drain(2) == 2.0
+
+
+class LazyBackendArray:
+    """An array on a backend where execution is deferred and
+    ``block_until_ready`` returns WITHOUT running the producer.
+
+    Any code path that demands the array's value (``__array__``) runs
+    the producer; event-style waiting does not. This models the axon
+    tunnel behavior that once produced the false 878-TFLOP/s reading.
+    """
+
+    def __init__(self, values):
+        self._values = np.asarray(values, np.float32)
+        self._result = None
+        self.block_until_ready_calls = 0
+        self.is_fully_addressable = True
+
+    @property
+    def executed(self) -> bool:
+        return self._result is not None
+
+    def block_until_ready(self):
+        # The lie at the heart of the failure mode: signals readiness
+        # while the work is still queued.
+        self.block_until_ready_calls += 1
+        return self
+
+    def __array__(self, dtype=None, copy=None):
+        if self._result is None:
+            self._result = self._values  # "executes" the producer
+        return np.asarray(self._result, dtype=dtype)
+
+
+def _install_lazy_backend(monkeypatch):
+    """Point utils.sync at the lazy backend: isinstance dispatch sees
+    LazyBackendArray as the device array type, and the reduction is a
+    host-side value fetch (what jnp.sum + float() amounts to on a real
+    backend once the bytes must cross the wire)."""
+    from torch_actor_critic_tpu.utils import sync
+
+    monkeypatch.setattr(
+        sync, "jax", types.SimpleNamespace(Array=LazyBackendArray)
+    )
+    monkeypatch.setattr(
+        sync,
+        "jnp",
+        types.SimpleNamespace(
+            sum=lambda x, dtype=None: np.sum(np.asarray(x), dtype=dtype),
+            float32=np.float32,
+        ),
+    )
+    return sync
+
+
+def test_drain_forces_execution_when_block_until_ready_lies(monkeypatch):
+    sync = _install_lazy_backend(monkeypatch)
+    x = LazyBackendArray([1.0, 2.0, 3.0])
+    assert not x.executed
+    assert sync.drain(x) == 6.0
+    # The ordering property the 878-TFLOP/s incident violated: by the
+    # time drain returns, the producer HAS run.
+    assert x.executed
+    # ... and not because drain fell back to the unreliable event.
+    assert x.block_until_ready_calls == 0
+
+
+def test_block_until_ready_alone_would_not_execute():
+    """Control for the stub: the event-style barrier drain replaced
+    leaves the work unexecuted on this backend — i.e. the stub really
+    does model the failure mode, and a regression of drain back to
+    block_until_ready would be caught by the test above."""
+    x = LazyBackendArray([1.0, 2.0, 3.0])
+    x.block_until_ready()
+    assert not x.executed
+    assert x.block_until_ready_calls == 1
+
+
+def test_drain_multihost_shard_fetch_also_executes(monkeypatch):
+    """The not-fully-addressable branch drains via a local-shard fetch,
+    which must equally demand bytes (run the producer)."""
+    sync = _install_lazy_backend(monkeypatch)
+    shard = LazyBackendArray([4.0, 5.0])
+    x = LazyBackendArray([0.0])  # container; only shards are fetched
+    x.is_fully_addressable = False
+    x.addressable_shards = [types.SimpleNamespace(data=shard)]
+    assert sync.drain(x) == 9.0
+    assert shard.executed
+    assert not x.executed  # only the local shard crosses the wire
+    assert shard.block_until_ready_calls == 0

@@ -137,6 +137,69 @@ def test_warmup_counters_scale_with_envs(dp):
     tr.close()
 
 
+def test_seeded_eval_is_pool_width_invariant():
+    """VERDICT r3 #9: evaluation round-robins the whole env pool. The
+    concurrent protocol must not change WHAT is measured — episode i
+    still resets with seed+i, so under a deterministic policy the
+    seeded eval of the same params is the same set of trajectories at
+    any pool width (3 episodes over 2 slots exercises the round-robin
+    handoff). Equality is up to batch-width float reassociation: the
+    actor's matmul reduces a width-1 and a width-2 batch in different
+    orders, so returns agree to ~1e-9, not bitwise."""
+    cfg = SACConfig(**TINY)
+    evs = []
+    for dp in (1, 2):
+        tr = Trainer("Pendulum-v1", cfg, mesh=make_mesh(dp=dp), seed=0)
+        evs.append(tr.evaluate(episodes=3, deterministic=True, seed=5))
+        tr.close()
+    assert evs[0]["ep_len_mean"] == evs[1]["ep_len_mean"]
+    assert evs[0]["ep_ret_mean"] == pytest.approx(
+        evs[1]["ep_ret_mean"], rel=1e-6
+    )
+    assert evs[0]["ep_ret_std"] == pytest.approx(
+        evs[1]["ep_ret_std"], rel=1e-6
+    )
+
+
+def test_fixed_alpha_dm_control_warns(caplog):
+    """VERDICT r3 #7: dm_control's [0,1]-per-step rewards are swamped
+    by the reference-default fixed alpha=0.2 (measured 0.5 vs 228.0 on
+    dm:cheetah:run at 100k — PARITY.md); the trainer must convert that
+    silent failure into a guided one. The reference fails silently
+    (ref main.py:148 fixed alpha, no diagnostics)."""
+    import logging
+
+    pytest.importorskip("dm_control")
+    cfg = SACConfig(**TINY)
+    with caplog.at_level(logging.WARNING, logger="torch_actor_critic_tpu"):
+        tr = Trainer("dm:cartpole:balance", cfg, mesh=make_mesh(dp=1))
+        tr.close()
+    assert any("learn-alpha" in r.getMessage() for r in caplog.records)
+
+    # Guided configurations stay quiet: learned temperature, or TD3
+    # (no entropy term at all), or a gymnasium-scale reward env.
+    for env, overrides in (
+        ("dm:cartpole:balance", {"learn_alpha": True}),
+        ("dm:cartpole:balance", {"algorithm": "td3"}),
+        ("Pendulum-v1", {}),
+        # Visual but NOT dm_control: gymnasium-scale rewards, no warning
+        ("PixelPendulum-v0", {
+            "filters": (8, 16), "kernel_sizes": (4, 3), "strides": (2, 2),
+            "cnn_dense_size": 32,
+        }),
+    ):
+        caplog.clear()
+        with caplog.at_level(
+            logging.WARNING, logger="torch_actor_critic_tpu"
+        ):
+            tr = Trainer(env, SACConfig(**{**TINY, **overrides}),
+                         mesh=make_mesh(dp=1))
+            tr.close()
+        assert not any(
+            "learn-alpha" in r.getMessage() for r in caplog.records
+        ), (env, overrides)
+
+
 def test_dm_control_cheetah_run_trains():
     """BASELINE config 3: dm_control cheetah-run through the gym-style
     wrapper, end-to-end short training (the reference reaches dm tasks

@@ -216,6 +216,10 @@ def make_env(name: str, seed: int | None = None, **kwargs):
         from torch_actor_critic_tpu.envs.wall_runner import DeepMindWallRunner
 
         return DeepMindWallRunner(seed=seed)
+    if name == "PixelPendulum-v0":
+        from torch_actor_critic_tpu.envs.pixel_pendulum import PixelPendulum
+
+        return PixelPendulum(seed=seed, **kwargs)
     if name.startswith("dm:"):
         _, domain, task = name.split(":")
         return DmControlEnv(domain, task, seed=seed)
@@ -225,4 +229,4 @@ def make_env(name: str, seed: int | None = None, **kwargs):
 def is_visual_env(name: str) -> bool:
     """Mixed-observation envs need the visual model/buffer stack
     (ref string dispatch at ``main.py:63,105``)."""
-    return name == "DeepMindWallRunner-v0"
+    return name in ("DeepMindWallRunner-v0", "PixelPendulum-v0")

@@ -22,9 +22,12 @@ TPU-native differences:
   reference's scalar-vision bottleneck exactly (parity mode); widening
   it (e.g. 64) is the recommended deliberate deviation flagged in
   SURVEY.md §7 item 2.
-- The twin visual critic is a vmapped parameter ensemble like
-  :class:`~torch_actor_critic_tpu.models.critic.DoubleCritic`, not two
-  sequential submodules (ref ``convolutional.py:167-183``).
+- The twin visual critic is an explicitly unrolled ensemble (dense
+  convs), NOT vmapped like
+  :class:`~torch_actor_critic_tpu.models.critic.DoubleCritic`: vmapping
+  per-member conv kernels lowers to grouped convolutions, which both
+  XLA:CPU and the MXU handle far worse than independent dense convs
+  (see :class:`VisualDoubleCritic`).
 """
 
 from __future__ import annotations
@@ -132,6 +135,7 @@ def _visual_actor_trunk(mod, features: jax.Array, frame: jax.Array) -> jax.Array
         mod.filters,
         mod.kernel_sizes,
         mod.strides,
+        dense_size=mod.cnn_dense_size,
         out_features=mod.cnn_features,
         normalize_pixels=mod.normalize_pixels,
         dtype=mod.dtype,
@@ -157,6 +161,7 @@ class VisualActor(nn.Module):
     kernel_sizes: t.Sequence[int] = (8, 4, 3)
     strides: t.Sequence[int] = (4, 2, 1)
     cnn_features: int = 1
+    cnn_dense_size: int = 512  # conv trunk dense width (ref convolutional.py:36)
     normalize_pixels: bool = False
     dtype: t.Any = jnp.float32  # see Actor.dtype: matmuls only, heads cast f32
 
@@ -206,6 +211,7 @@ class DeterministicVisualActor(nn.Module):
     kernel_sizes: t.Sequence[int] = (8, 4, 3)
     strides: t.Sequence[int] = (4, 2, 1)
     cnn_features: int = 1
+    cnn_dense_size: int = 512  # conv trunk dense width (ref convolutional.py:36)
     normalize_pixels: bool = False
     dtype: t.Any = jnp.float32
 
@@ -250,6 +256,7 @@ class VisualCritic(nn.Module):
     kernel_sizes: t.Sequence[int] = (8, 4, 3)
     strides: t.Sequence[int] = (4, 2, 1)
     cnn_features: int = 1
+    cnn_dense_size: int = 512  # conv trunk dense width (ref convolutional.py:36)
     normalize_pixels: bool = False
     dtype: t.Any = jnp.float32  # see Critic.dtype: Q cast back to float32
 
@@ -273,6 +280,7 @@ class VisualCritic(nn.Module):
             self.filters,
             self.kernel_sizes,
             self.strides,
+            dense_size=self.cnn_dense_size,
             out_features=self.cnn_features,
             normalize_pixels=self.normalize_pixels,
             dtype=dtype,
@@ -287,10 +295,23 @@ class VisualCritic(nn.Module):
 
 
 class VisualDoubleCritic(nn.Module):
-    """Vmapped ensemble of ``num_qs`` visual critics; returns ``(num_qs, ...)``.
+    """Unrolled ensemble of ``num_qs`` visual critics; returns ``(num_qs, ...)``.
 
     Capability twin of the reference ``VisualDoubleCritic``
     (ref ``convolutional.py:167-183``).
+
+    Unlike the flat :class:`~torch_actor_critic_tpu.models.critic.DoubleCritic`
+    (a vmapped parameter ensemble — matmuls batch perfectly over the
+    ensemble axis), this ensemble is an explicit Python unroll over
+    ``num_qs`` submodules (``ensemble_0``, ``ensemble_1``, ...). A
+    vmapped *conv* with per-member kernels lowers to a
+    ``feature_group_count=num_qs`` grouped convolution, which XLA:CPU
+    implements naively (~7x slower than the equivalent dense convs,
+    measured) and XLA:TPU tiles poorly onto the MXU; ``num_qs``
+    independent dense convs fuse and schedule well on both. Per-layer
+    group structure is inherent past the first conv (each member's
+    layer N may only see its own layer N-1 outputs), so the unroll —
+    not a wider fused conv — is the faithful dense formulation.
     """
 
     hidden_sizes: t.Sequence[int] = (256, 256)
@@ -298,27 +319,25 @@ class VisualDoubleCritic(nn.Module):
     kernel_sizes: t.Sequence[int] = (8, 4, 3)
     strides: t.Sequence[int] = (4, 2, 1)
     cnn_features: int = 1
+    cnn_dense_size: int = 512  # conv trunk dense width (ref convolutional.py:36)
     normalize_pixels: bool = False
     num_qs: int = 2
     dtype: t.Any = jnp.float32
 
     @nn.compact
     def __call__(self, obs: MultiObservation, action: jax.Array) -> jax.Array:
-        ensemble = nn.vmap(
-            VisualCritic,
-            variable_axes={"params": 0},
-            split_rngs={"params": True},
-            in_axes=None,
-            out_axes=0,
-            axis_size=self.num_qs,
-        )
-        return ensemble(
-            self.hidden_sizes,
-            self.filters,
-            self.kernel_sizes,
-            self.strides,
-            self.cnn_features,
-            self.normalize_pixels,
-            dtype=self.dtype,
-            name="ensemble",
-        )(obs, action)
+        qs = [
+            VisualCritic(
+                self.hidden_sizes,
+                self.filters,
+                self.kernel_sizes,
+                self.strides,
+                self.cnn_features,
+                self.cnn_dense_size,
+                self.normalize_pixels,
+                dtype=self.dtype,
+                name=f"ensemble_{i}",
+            )(obs, action)
+            for i in range(self.num_qs)
+        ]
+        return jnp.stack(qs, axis=0)

@@ -80,6 +80,7 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
                 kernel_sizes=config.kernel_sizes,
                 strides=config.strides,
                 cnn_features=config.cnn_features,
+                cnn_dense_size=config.cnn_dense_size,
                 normalize_pixels=config.normalize_pixels,
                 dtype=dtype,
             )
@@ -89,6 +90,7 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
                 kernel_sizes=config.kernel_sizes,
                 strides=config.strides,
                 cnn_features=config.cnn_features,
+                cnn_dense_size=config.cnn_dense_size,
                 normalize_pixels=config.normalize_pixels,
                 num_qs=config.num_qs,
                 dtype=dtype,
@@ -122,6 +124,7 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
             kernel_sizes=config.kernel_sizes,
             strides=config.strides,
             cnn_features=config.cnn_features,
+            cnn_dense_size=config.cnn_dense_size,
             normalize_pixels=config.normalize_pixels,
             dtype=dtype,
         )
@@ -131,6 +134,7 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
             kernel_sizes=config.kernel_sizes,
             strides=config.strides,
             cnn_features=config.cnn_features,
+            cnn_dense_size=config.cnn_dense_size,
             normalize_pixels=config.normalize_pixels,
             num_qs=config.num_qs,
             dtype=dtype,
@@ -233,6 +237,33 @@ class Trainer:
         self.config = config or SACConfig()
         self.env_name = env_name
         self.seed = seed
+        if (
+            self.config.algorithm == "sac"
+            and not self.config.learn_alpha
+            and (
+                env_name.startswith("dm:")
+                or env_name == "DeepMindWallRunner-v0"
+            )
+        ):
+            # Scope: dm_control-backed envs only — other visual envs
+            # (e.g. PixelPendulum wrapping Pendulum-v1) pay
+            # gymnasium-scale rewards where fixed alpha works fine.
+            # dm_control tasks pay [0, 1]-per-step rewards; the fixed
+            # alpha=0.2 entropy bonus (the reference's default, ref
+            # main.py:148) is the same order of magnitude and swamps
+            # them — measured on dm:cheetah:run at 100k steps: eval 0.5
+            # with fixed alpha vs 228.0 with --learn-alpha true
+            # (PARITY.md). The reference fails this way silently.
+            logger.warning(
+                "%s pays dm_control-scale rewards ([0, 1] per step) and "
+                "SAC is running with a FIXED entropy temperature "
+                "alpha=%g; the entropy bonus is likely to swamp the "
+                "reward signal (measured: eval 0.5 vs 228.0 on "
+                "dm:cheetah:run at 100k steps). Pass --learn-alpha true "
+                "to tune the temperature automatically.",
+                env_name,
+                self.config.alpha,
+            )
         self.mesh = mesh if mesh is not None else make_mesh()
         # One env per LOCAL dp slice: each host simulates only the envs
         # feeding replay shards it can address (multi-host: no
@@ -710,24 +741,56 @@ class Trainer:
     def _evaluate_episodes(
         self, episodes: int, deterministic: bool, render: bool, seed: int | None
     ) -> dict:
+        """Concurrent rollouts over the whole env pool.
+
+        Every pool env evaluates simultaneously: one batched policy
+        call serves all in-flight episodes (fixed batch width, so the
+        actor compiles once), and episode ``i`` still resets with
+        ``seed + i`` regardless of which slot runs it — under a
+        deterministic policy the per-episode trajectories are
+        slot-assignment invariant, so seeded results match the
+        single-env protocol while wall-clock drops ~n_envs-fold.
+        The reference evaluates one env serially (ref
+        ``run_agent.py:19-48``).
+        """
+        n_slots = min(self.n_envs, episodes)
+        next_ep = 0
+        obs, rets, lens, live = [], [], [], []
+        for slot in range(n_slots):
+            ep_seed = None if seed is None else seed + next_ep
+            next_ep += 1
+            o = self._normalize(self.pool.reset_at(slot, seed=ep_seed), update=False)
+            obs.append(o)
+            rets.append(0.0)
+            lens.append(0)
+            live.append(True)
         returns, lengths = [], []
-        for ep in range(episodes):
-            ep_seed = None if seed is None else seed + ep
-            o = self._normalize(self.pool.reset_at(0, seed=ep_seed), update=False)
-            done = False
-            ret, length = 0.0, 0
-            while not done and length < self.config.max_ep_len:
-                batched = jax.tree_util.tree_map(lambda x: x[None], o)
-                a = self._policy_actions(batched, deterministic=deterministic)[0]
-                o, r, terminated, truncated = self.pool.step_at(0, a)
-                o = self._normalize(o, update=False)
-                ret += r
-                length += 1
-                done = terminated or truncated
+        while any(live):
+            # Fixed-width batch: finished slots keep their last obs as
+            # padding rows whose actions are discarded.
+            batched = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *obs)
+            actions = self._policy_actions(batched, deterministic=deterministic)
+            for slot in range(n_slots):
+                if not live[slot]:
+                    continue
+                o, r, terminated, truncated = self.pool.step_at(slot, actions[slot])
+                obs[slot] = self._normalize(o, update=False)
+                rets[slot] += r
+                lens[slot] += 1
                 if render and self._render_ok:
-                    self.pool.render_at(0)
-            returns.append(ret)
-            lengths.append(length)
+                    self.pool.render_at(slot)
+                if terminated or truncated or lens[slot] >= self.config.max_ep_len:
+                    returns.append(rets[slot])
+                    lengths.append(lens[slot])
+                    if next_ep < episodes:
+                        ep_seed = None if seed is None else seed + next_ep
+                        next_ep += 1
+                        obs[slot] = self._normalize(
+                            self.pool.reset_at(slot, seed=ep_seed), update=False
+                        )
+                        rets[slot], lens[slot] = 0.0, 0
+                    else:
+                        live[slot] = False
         return {
             "ep_ret_mean": float(np.mean(returns)),
             "ep_ret_std": float(np.std(returns)),

@@ -30,7 +30,9 @@ from torch_actor_critic_tpu.core.types import BufferState, TrainState
 #    (``col``/``row``/``Dense_0``) instead of always ``Dense_0`` —
 #    checkpoints written before that rename have a different tree
 #    structure and cannot be restored into current models.
-CKPT_FORMAT = 2
+CKPT_FORMAT = 3  # 3: VisualDoubleCritic ensemble unrolled (ensemble_i
+# submodules, dense convs) — visual param trees from format<=2 (vmapped
+# 'ensemble' with a stacked leading axis) no longer restore
 
 
 class Checkpointer:

@@ -71,6 +71,7 @@ class SACConfig:
     kernel_sizes: t.Tuple[int, ...] = (8, 4, 3)
     strides: t.Tuple[int, ...] = (4, 2, 1)
     cnn_features: int = 1  # 1 == reference scalar-vision bottleneck
+    cnn_dense_size: int = 512  # conv-trunk dense width (ref convolutional.py:36)
     normalize_pixels: bool = False
 
     # Sequence-policy extension: history_len > 1 wraps the env in a
