@@ -555,11 +555,10 @@ def bench_attention(budget_s=180.0, t=2048, block_sweep=False):
             lambda q, k, v: q * 0.999 + 1e-3 * attention(q, k, v, causal=True)
         )
 
-        def loss_vjp_blocks(q, k, v, g, block_q=None, block_k=None):
+        def loss_vjp_blocks(q, k, v, g, attn=None):
             _, vjp = jax.vjp(
-                lambda q, k, v: attention(
-                    q, k, v, causal=True, block_q=block_q, block_k=block_k
-                ), q, k, v,
+                attn or (lambda q, k, v: attention(q, k, v, causal=True)),
+                q, k, v,
             )
             # Fold ALL THREE grads into the chained output (tq == tk
             # here, so shapes match) — returning only dq would let XLA
@@ -619,22 +618,37 @@ def bench_attention(budget_s=180.0, t=2048, block_sweep=False):
         # Opt-in per call: each point pays a fresh Pallas fwd+bwd
         # compile, so the caller must budget for it.
         if block_sweep and jax.default_backend() == "tpu":
+            from torch_actor_critic_tpu.ops.attention import flash_attention
+
+            # (block_q, block_k, pad_lanes): 128 = the zero-padded
+            # native lane layout; 64 keeps a d=64 head at true width
+            # (half the q/k/v/o HBM traffic — the MXU is 50%-bounded
+            # at d=64 either way, see SCALING.md's attention roofline).
             sweep = []
-            for bq, bk in ((128, 256), (256, 256), (256, 512), (512, 512)):
+            for bq, bk, lanes in (
+                (128, 256, 128), (256, 256, 128), (256, 512, 128),
+                (512, 512, 128), (512, 1024, 128), (1024, 1024, 128),
+                (512, 512, 64), (1024, 1024, 64),
+            ):
                 if time.time() - t_start > budget_s:
                     break
                 try:
                     f = jax.jit(functools.partial(
-                        loss_vjp_blocks, block_q=bq, block_k=bk
+                        loss_vjp_blocks,
+                        attn=functools.partial(
+                            flash_attention, causal=True, block_q=bq,
+                            block_k=bk, pad_lanes=lanes,
+                        ),
                     ))
                     dt = timed(f, qb, kb, vb, gb)
                     sweep.append({
-                        "block_q": bq, "block_k": bk,
+                        "block_q": bq, "block_k": bk, "pad_lanes": lanes,
                         "fwd_bwd_ms": round(dt * 1e3, 2),
                         "fwd_bwd_tflops": round(flops_bwd / dt / 1e12, 2),
                     })
                 except Exception as e:  # noqa: BLE001 — per-point
                     sweep.append({"block_q": bq, "block_k": bk,
+                                  "pad_lanes": lanes,
                                   "error": repr(e)[:200]})
             if sweep:
                 out["block_sweep"] = sweep
@@ -645,9 +659,15 @@ def bench_attention(budget_s=180.0, t=2048, block_sweep=False):
                 )
                 if best and "fwd_bwd_tflops_bf16" in out:
                     out["best_blocks"] = [best["block_q"], best["block_k"]]
+                    out["best_pad_lanes"] = best.get("pad_lanes", 128)
                     out["best_blocks_tflops"] = max(
                         best["fwd_bwd_tflops"], out["fwd_bwd_tflops_bf16"]
                     )
+        # Roofline context for the numbers above (SCALING.md, attention
+        # section): at d=64 both kernel matmuls run a 64-wide
+        # contraction/output on the 128x128 MXU, so the achievable
+        # ceiling is <=50% of nominal peak regardless of software.
+        out["achievable_peak_frac_d64"] = 0.5
         log(f"attention: {out}")
     except Exception as e:  # noqa: BLE001 — best-effort section
         out["error"] = repr(e)

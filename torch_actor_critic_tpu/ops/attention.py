@@ -255,10 +255,16 @@ def _flash_kernel(
             scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
         m = m_ref[:, 0]
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
-        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.exp(scores - safe_m[:, None])
-        p = jnp.where(jnp.isneginf(scores), 0.0, p)
-        alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        # No isneginf guards in-kernel (unlike online_block_update,
+        # whose ring-attention callers CAN see fully-masked rows): the
+        # causal k-skip still runs j=0, where every row sees key 0, so
+        # m_new is finite from the first visited block on. Masked
+        # scores are -inf -> exp(-inf - finite) = 0, and the j=0
+        # alpha = exp(-inf - finite) = 0 wipes the zero-init state.
+        # The softmax tail is VPU-bound; each removed elementwise pass
+        # over the (block_q, block_k) tile is measurable throughput.
+        p = jnp.exp(scores - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
         l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
         acc_ref[:] = acc_ref[:] * alpha[:, None] + _acc_dot(
             p, v_blk, ((1,), (0,))
@@ -273,8 +279,13 @@ def _flash_kernel(
         ).astype(o_ref.dtype)
         if save_lse:
             # Per-row logsumexp — the only forward residual the flash
-            # backward needs besides (q, k, v, o). All-masked rows keep
-            # lse = -inf, which the backward maps to zero probability.
+            # backward needs besides (q, k, v, o). INVARIANT: no
+            # in-kernel row is ever fully masked (causal rows always
+            # see key 0; there is no q/k offset on the Pallas path),
+            # so l > 0 and lse is finite here — the l == 0 guard below
+            # is defensive only, and the backward relies on finite lse
+            # (it has no isneginf path; extending this kernel to
+            # ring-attention offsets would need those guards back).
             # Stored broadcast across a 128-lane axis: Mosaic requires
             # (8, 128)-tileable output blocks, so a (1, block_q) row
             # vector is not lowerable — same layout as the reference
@@ -286,12 +297,21 @@ def _flash_kernel(
             lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
-def _pad_head_dim(*arrays: jax.Array) -> t.Tuple[jax.Array, ...]:
-    """Zero-pad the trailing (head) axis to the 128-lane width."""
+def _pad_head_dim(
+    *arrays: jax.Array, lanes: int = _LANE
+) -> t.Tuple[jax.Array, ...]:
+    """Zero-pad the trailing (head) axis to a multiple of ``lanes``.
+
+    ``lanes=128`` is the native lane width. ``lanes=64`` keeps a d=64
+    head at its true width: the MXU still runs at most 50% on a 64-wide
+    contraction either way (the 128x128 systolic array bound — see
+    SCALING.md's attention roofline), but the q/k/v/o tiles carry half
+    the HBM traffic and VMEM footprint of the zero-padded layout.
+    """
     d = arrays[0].shape[-1]
-    if d % _LANE == 0:
+    if d % lanes == 0:
         return arrays
-    pad = _LANE - d % _LANE
+    pad = lanes - d % lanes
     return tuple(
         jnp.pad(x, ((0, 0),) * (x.ndim - 1) + ((0, pad),)) for x in arrays
     )
@@ -343,6 +363,7 @@ def _flash_forward(
     block_k: int,
     interpret: bool,
     save_lse: bool = False,
+    pad_lanes: int = _LANE,
 ):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -374,7 +395,7 @@ def _flash_forward(
     # axis to the lane width (dot products are unchanged by zero columns,
     # padded output columns are sliced away).
     scale = 1.0 / math.sqrt(d)
-    q, k, v = _pad_head_dim(q, k, v)
+    q, k, v = _pad_head_dim(q, k, v, lanes=pad_lanes)
     dp = q.shape[-1]
     qr = q.reshape(b * h, tq, dp)
     kr = k.reshape(b * h, tk, dp)
@@ -443,9 +464,11 @@ def _attn_probs(q, k, lse, scale, causal, iq, jk, block_q, block_k):
             jnp.int32, (block_q, block_k), 1
         )
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    safe_lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
-    p = jnp.exp(s - safe_lse[:, None])
-    return jnp.where(jnp.isneginf(s), 0.0, p)
+    # lse is finite for every row inside the kernel (each causal row
+    # sees at least key 0 — see the forward's guard-removal note), and
+    # masked scores are -inf -> exp(-inf - finite) = 0 with no NaN
+    # path, so no isneginf passes are needed on the VPU-bound tail.
+    return jnp.exp(s - lse[:, None])
 
 
 def _flash_bwd_dq_kernel(
@@ -535,6 +558,7 @@ def _flash_bwd_dkv_kernel(
 
 def _flash_backward(
     q, k, v, o, lse, g, causal, block_q, block_k, interpret,
+    pad_lanes: int = _LANE,
 ):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -552,7 +576,7 @@ def _flash_backward(
     delta = jnp.sum(
         g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     ).reshape(b * h, tq)
-    q, k, v, g = _pad_head_dim(q, k, v, g)
+    q, k, v, g = _pad_head_dim(q, k, v, g, lanes=pad_lanes)
     dp = q.shape[-1]
     qr = q.reshape(b * h, tq, dp)
     kr = k.reshape(b * h, tk, dp)
@@ -624,7 +648,7 @@ def _flash_backward(
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -633,6 +657,7 @@ def flash_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool = False,
+    pad_lanes: int = _LANE,
 ):
     """Pallas TPU flash attention, forward *and* backward kernels.
 
@@ -652,20 +677,24 @@ def flash_attention(
     ``interpret=True`` runs the kernels in the Pallas interpreter
     (CPU-testable; used by the test suite).
     """
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_forward(
+        q, k, v, causal, block_q, block_k, interpret, pad_lanes=pad_lanes
+    )
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, pad_lanes):
     out, lse = _flash_forward(
-        q, k, v, causal, block_q, block_k, interpret, save_lse=True
+        q, k, v, causal, block_q, block_k, interpret, save_lse=True,
+        pad_lanes=pad_lanes,
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, block_q, block_k, interpret, pad_lanes, res, g):
     q, k, v, o, lse = res
     return _flash_backward(
-        q, k, v, o, lse, g, causal, block_q, block_k, interpret
+        q, k, v, o, lse, g, causal, block_q, block_k, interpret,
+        pad_lanes=pad_lanes,
     )
 
 
