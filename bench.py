@@ -507,11 +507,15 @@ def bench_on_device(budget_s=300.0):
     # dispatch + update cost); the history-8 point times the fused
     # long-context (causal-transformer) path — shapes the host-loop
     # reference cannot express at all.
+    # The pixel point runs the fused loop with ON-CHIP frame
+    # rasterization through the visual (CNN) stack — pixel training
+    # with zero host involvement.
     for env_name, n_envs, hist in (
         ("pendulum", 16, 1),
         ("cheetah", 16, 1),
         ("cheetah", 128, 1),
         ("cheetah", 16, 8),
+        ("pixel", 16, 1),
     ):
         key = env_name + ("" if n_envs == 16 else f"@{n_envs}")
         key += "" if hist == 1 else f"_h{hist}"

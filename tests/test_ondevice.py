@@ -356,3 +356,95 @@ def test_on_device_run_evaluates_through_host_eval_cli(tmp_path):
     ])
     assert np.isfinite(metrics["ep_ret_mean"])
     assert metrics["ep_len_mean"] == 200.0
+
+
+class TestPixelPendulumJax:
+    """On-chip-rendered pixel twin (VERDICT r3 #1: the visual stack
+    through the fused loop, frames rasterized in pure jnp)."""
+
+    def test_renderer_matches_host_env(self):
+        """render_rod_jax must be pixel-identical to the host env's
+        numpy renderer across the angle range (incl. wrap-around)."""
+        from torch_actor_critic_tpu.envs.pixel_pendulum import (
+            render_rod,
+            render_rod_jax,
+        )
+
+        for th in np.linspace(-7.0, 7.0, 29):
+            np.testing.assert_array_equal(
+                np.asarray(render_rod_jax(float(th))), render_rod(float(th))
+            )
+
+    def test_env_semantics(self):
+        from torch_actor_critic_tpu.envs.ondevice import PixelPendulumJax
+
+        st = PixelPendulumJax.reset(jax.random.key(0))
+        o = st.obs
+        assert o.frame.dtype == jnp.uint8
+        # No motion at reset: both rod channels coincide; features = 0.
+        np.testing.assert_array_equal(
+            np.asarray(o.frame[..., 0]), np.asarray(o.frame[..., 1])
+        )
+        np.testing.assert_array_equal(np.asarray(o.features), 0.0)
+
+        a = jnp.array([1.5])
+        step = jax.jit(PixelPendulumJax.step)
+        moved = False
+        for _ in range(5):
+            st, out = step(st, a)
+            moved = moved or bool(
+                (out.next_obs.frame[..., 0] != out.next_obs.frame[..., 1]).any()
+            )
+        assert moved  # velocity observable from the two-rod channels
+        np.testing.assert_array_equal(np.asarray(out.next_obs.features), 1.5)
+
+    def test_auto_reset_restores_motionless_frame(self):
+        from torch_actor_critic_tpu.envs.ondevice import PixelPendulumJax
+
+        st = PixelPendulumJax.reset(jax.random.key(1))
+        a = jnp.array([2.0])
+        step = jax.jit(PixelPendulumJax.step)
+        for i in range(PixelPendulumJax.max_episode_steps):
+            st, out = step(st, a)
+        assert bool(out.ended)
+        # Post-reset obs: fresh episode, no motion, no previous action.
+        np.testing.assert_array_equal(
+            np.asarray(st.obs.frame[..., 0]), np.asarray(st.obs.frame[..., 1])
+        )
+        np.testing.assert_array_equal(np.asarray(st.obs.features), 0.0)
+        # Pre-reset obs kept the old episode's (moving) pose for replay.
+        assert int(st.step_count) == 0
+
+    def test_fused_pixel_epoch(self):
+        """The fused loop trains the visual stack end-to-end on the
+        on-chip-rendered env: warmup fills the pytree buffer with uint8
+        frames, a burst produces finite losses."""
+        from torch_actor_critic_tpu.envs.ondevice import PixelPendulumJax
+        from torch_actor_critic_tpu.sac.trainer import build_models, make_learner
+        from torch_actor_critic_tpu.sac.ondevice import _SpecView
+
+        cfg = SACConfig(
+            hidden_sizes=(16, 16), batch_size=8,
+            filters=(8, 16), kernel_sizes=(4, 3), strides=(2, 2),
+            cnn_dense_size=32, cnn_features=8, normalize_pixels=True,
+        )
+        actor, critic = build_models(cfg, _SpecView(PixelPendulumJax))
+        sac = make_learner(cfg, actor, critic, PixelPendulumJax.act_dim)
+        loop = OnDeviceLoop(sac, PixelPendulumJax, n_envs=4)
+        ts, buf, es, key = loop.init(jax.random.key(0), buffer_capacity=2_000)
+        ts, buf, es, key, _ = loop.epoch(ts, buf, es, key, steps=25, update_every=25, warmup=True)
+        assert int(buf.size) == 25 * 4
+        assert buf.data.states.frame.dtype == jnp.uint8
+        ts, buf, es, key, m = loop.epoch(ts, buf, es, key, steps=25, update_every=25)
+        assert int(ts.step) == 25
+        assert np.isfinite(float(m["loss_q"]))
+        assert np.isfinite(float(m["loss_pi"]))
+
+    def test_history_wrap_rejected(self):
+        from torch_actor_critic_tpu.envs.ondevice import (
+            PixelPendulumJax,
+            history_env,
+        )
+
+        with pytest.raises(ValueError, match="pytree"):
+            history_env(PixelPendulumJax, 8)

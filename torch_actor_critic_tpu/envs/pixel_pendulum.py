@@ -68,6 +68,31 @@ def render_rod(theta: float, size: int = SIZE) -> np.ndarray:
     return np.where(dist <= ROD_HALF_WIDTH, 255, 0).astype(np.uint8)
 
 
+def render_rod_jax(theta: jax.Array, size: int = SIZE) -> jax.Array:
+    """:func:`render_rod` in pure jnp — the on-device twin's renderer
+    (``envs/ondevice.PixelPendulumJax`` rasterizes frames *on chip*, so
+    pixel training can run inside the fused loop with zero host
+    involvement). Must stay numerically identical to the numpy version;
+    ``tests/test_ondevice.py::TestPixelPendulumJax::
+    test_renderer_matches_host_env`` pins the parity.
+    """
+    c = (size - 1) / 2.0
+    length = size * ROD_LEN_FRAC
+    tip = jnp.stack(
+        [c - length * jnp.cos(theta), c + length * jnp.sin(theta)]
+    )
+    pivot = jnp.array([c, c])
+    rows = jax.lax.broadcasted_iota(jnp.float32, (size, size), 0)
+    cols = jax.lax.broadcasted_iota(jnp.float32, (size, size), 1)
+    p = jnp.stack([rows, cols], axis=-1)
+    seg = tip - pivot
+    seg_len2 = jnp.sum(seg * seg)
+    t_par = jnp.clip(((p - pivot) @ seg) / seg_len2, 0.0, 1.0)
+    closest = pivot + t_par[..., None] * seg
+    dist = jnp.linalg.norm(p - closest, axis=-1)
+    return jnp.where(dist <= ROD_HALF_WIDTH, 255, 0).astype(jnp.uint8)
+
+
 class PixelPendulum:
     """Pendulum-v1 with pixel observations (framework env protocol)."""
 

@@ -73,9 +73,7 @@ class OnDeviceLoop:
         """``buffer_capacity`` is per dp slice, matching the reference's
         per-worker buffers (ref ``main.py:140-141``)."""
         k_state, k_envs, k_act = jax.random.split(key, 3)
-        # (horizon, D) for history-wrapped envs, (D,) for flat ones.
-        obs_shape = getattr(self.env, "obs_shape", (self.env.obs_dim,))
-        obs_spec = jax.ShapeDtypeStruct(obs_shape, jnp.float32)
+        obs_spec, zero_obs = _env_obs_spec(self.env)
         # Same HBM-budget check as the host trainer (shared helper so
         # the two loops' thresholds cannot drift): history windows
         # multiply the resident shard by horizon, and the fused loop
@@ -88,7 +86,7 @@ class OnDeviceLoop:
             buffer_capacity, obs_spec, self.env.act_dim,
             advice="reduce buffer_capacity (or history_len)",
         )
-        train_state = self.sac.init_state(k_state, jnp.zeros(obs_shape))
+        train_state = self.sac.init_state(k_state, zero_obs)
         buffer = init_replay_buffer(buffer_capacity, obs_spec, self.env.act_dim)
         if self.mesh is None:
             env_states = jax.vmap(self.env.reset)(
@@ -309,14 +307,26 @@ class OnDeviceLoop:
         return self._epoch_fns[sig](train_state, buffer, env_states, act_key)
 
 
+def _env_obs_spec(env_cls):
+    """Resolve an on-device env's observation spec and a zero example.
+
+    Pytree-observation envs (e.g. the pixel twin) expose ``obs_spec()``
+    /``zero_obs()`` classmethods; flat envs carry ``obs_dim`` (or
+    ``obs_shape`` when history-wrapped) and stay float32 vectors.
+    """
+    if hasattr(env_cls, "obs_spec"):
+        spec = env_cls.obs_spec()
+        return spec, env_cls.zero_obs()
+    shape = getattr(env_cls, "obs_shape", (env_cls.obs_dim,))
+    return jax.ShapeDtypeStruct(shape, jnp.float32), jnp.zeros(shape)
+
+
 class _SpecView:
     """The env-protocol triple ``build_models`` dispatches on, derived
     from an on-device env class (which carries shapes as class attrs)."""
 
     def __init__(self, env_cls):
-        self.obs_spec = jax.ShapeDtypeStruct(
-            getattr(env_cls, "obs_shape", (env_cls.obs_dim,)), jnp.float32
-        )
+        self.obs_spec, _ = _env_obs_spec(env_cls)
         self.act_dim = env_cls.act_dim
         self.act_limit = env_cls.act_limit
 
@@ -452,13 +462,28 @@ def benchmark_on_device(
     from torch_actor_critic_tpu.envs.ondevice import get_on_device_env
     from torch_actor_critic_tpu.utils.config import SACConfig
 
-    aliases = {"pendulum": "Pendulum-v1", "cheetah": "cheetah-run-jax"}
+    aliases = {
+        "pendulum": "Pendulum-v1",
+        "cheetah": "cheetah-run-jax",
+        "pixel": "PixelPendulum-v0",
+    }
     env_cls = get_on_device_env(aliases.get(env_name, env_name))
     if env_cls is None:
         raise ValueError(f"no on-device twin for {env_name!r}")
-    cfg = SACConfig(
-        hidden_sizes=(256, 256), batch_size=64, history_len=history_len
-    )
+    if hasattr(env_cls, "obs_spec"):
+        # Pixel twin: conv geometry sized for its 32x32 frames (the
+        # Atari defaults need >=36px), widened cnn_features — the
+        # configuration the committed pixelpend-wide learning run uses.
+        cfg = SACConfig(
+            hidden_sizes=(256, 256), batch_size=64,
+            filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
+            cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
+            history_len=history_len,
+        )
+    else:
+        cfg = SACConfig(
+            hidden_sizes=(256, 256), batch_size=64, history_len=history_len
+        )
     env_cls, sac = _wrap_and_build(env_cls, cfg)
     loop = OnDeviceLoop(sac, env_cls, n_envs=n_envs)
     ts, buf, es, key = loop.init(jax.random.key(0), buffer_capacity=200_000)
