@@ -398,6 +398,27 @@ class TestPixelPendulumJax:
         assert moved  # velocity observable from the two-rod channels
         np.testing.assert_array_equal(np.asarray(out.next_obs.features), 1.5)
 
+    def test_temporal_channel_order(self):
+        """Channels are (t-2, t-1, t), pinned against the renderer: a
+        reversed or shifted `next_hist` carry must fail here, not ship
+        silently scrambling the velocity signal."""
+        from torch_actor_critic_tpu.envs.ondevice import PixelPendulumJax
+        from torch_actor_critic_tpu.envs.pixel_pendulum import render_rod_jax
+
+        st = PixelPendulumJax.reset(jax.random.key(2))
+        thetas = [float(st.inner[0])]
+        a = jnp.array([1.0])
+        step = jax.jit(PixelPendulumJax.step)
+        for t in range(4):
+            st, out = step(st, a)
+            thetas.append(float(st.inner[0]))
+            expected = [thetas[max(t - 1, 0)], thetas[t], thetas[t + 1]]
+            for c, th in enumerate(expected):
+                np.testing.assert_array_equal(
+                    np.asarray(out.next_obs.frame[..., c]),
+                    np.asarray(render_rod_jax(th)),
+                )
+
     def test_auto_reset_restores_motionless_frame(self):
         from torch_actor_critic_tpu.envs.ondevice import PixelPendulumJax
 

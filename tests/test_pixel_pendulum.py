@@ -42,8 +42,9 @@ def test_observation_contains_no_scalar_state():
     assert o.features.shape == (env.act_dim,)
     np.testing.assert_array_equal(o.features, 0.0)  # no action yet
     assert o.frame.shape == (SIZE, SIZE, 3) and o.frame.dtype == np.uint8
-    # At reset there is no motion: both rod channels coincide.
+    # At reset there is no motion: all three rod channels coincide.
     np.testing.assert_array_equal(o.frame[..., 0], o.frame[..., 1])
+    np.testing.assert_array_equal(o.frame[..., 1], o.frame[..., 2])
 
     a = np.array([1.7], np.float32)
     o2, r, term, trunc = env.step(a)
@@ -53,9 +54,9 @@ def test_observation_contains_no_scalar_state():
 
 
 def test_velocity_is_observable_from_one_frame():
-    """Channel 0 holds the previous rod, channel 1 the current one —
-    once the pendulum moves, the channels differ (without this the task
-    would be partially observed: velocity aliasing, not vision)."""
+    """Channels hold the rod at t-2, t-1 and t — once the pendulum
+    moves, they differ (without this the task would be partially
+    observed: velocity aliasing, not vision)."""
     env = PixelPendulum(seed=0)
     env.reset(seed=0)
     moved = False
@@ -63,6 +64,23 @@ def test_velocity_is_observable_from_one_frame():
         o, *_ = env.step(np.array([2.0], np.float32))
         moved = moved or (o.frame[..., 0] != o.frame[..., 1]).any()
     assert moved
+    env.close()
+
+
+def test_temporal_channel_order():
+    """Channels are (t-2, t-1, t) — pinned against the renderer so a
+    reversed or shifted history cannot ship silently (the velocity /
+    trend signal depends on this ordering)."""
+    env = PixelPendulum(seed=0)
+    env.reset(seed=3)
+    thetas = [env._theta()]
+    a = np.array([1.0], np.float32)
+    for t in range(4):
+        o, *_ = env.step(a)
+        thetas.append(env._theta())
+        expected = [thetas[max(t - 1, 0)], thetas[t], thetas[t + 1]]
+        for c, th in enumerate(expected):
+            np.testing.assert_array_equal(o.frame[..., c], render_rod(th))
     env.close()
 
 

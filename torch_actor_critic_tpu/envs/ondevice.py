@@ -338,8 +338,9 @@ class CheetahRunJax:
 
 class PixelPendulumJax:
     """On-device twin of ``envs.pixel_pendulum.PixelPendulum``: the
-    same honest pixel task (two-rod-channel uint8 frame, features =
-    previous action only — no scalar state leaks), with the frame
+    same honest pixel task (anti-aliased rod raster at t-2/t-1/t in
+    the three uint8 channels, features = previous action only — no
+    scalar state leaks), with the frame
     **rasterized on chip** by ``render_rod_jax``. Physics delegates to
     :class:`PendulumJax`, so the fused loop trains a *visual* SAC
     policy end-to-end with zero host involvement — the capability
@@ -375,21 +376,12 @@ class PixelPendulumJax:
         )
 
     @classmethod
-    def _obs(cls, prev_theta, theta, last_action):
+    def _obs(cls, thetas, last_action):
+        """Observation from the (t-2, t-1, t) pose triple."""
         from torch_actor_critic_tpu.core.types import MultiObservation
-        from torch_actor_critic_tpu.envs.pixel_pendulum import (
-            SIZE,
-            render_rod_jax,
-        )
+        from torch_actor_critic_tpu.envs.pixel_pendulum import render_rod_jax
 
-        frame = jnp.stack(
-            [
-                render_rod_jax(prev_theta),
-                render_rod_jax(theta),
-                jnp.zeros((SIZE, SIZE), jnp.uint8),
-            ],
-            axis=-1,
-        )
+        frame = jnp.stack([render_rod_jax(th) for th in thetas], axis=-1)
         return MultiObservation(
             features=jnp.reshape(last_action, (cls.act_dim,)).astype(
                 jnp.float32
@@ -401,33 +393,46 @@ class PixelPendulumJax:
     def reset(cls, key: jax.Array) -> EnvState:
         base = PendulumJax.reset(key)
         theta, theta_dot = base.inner
-        # No motion at reset: both rod channels show the same pose.
+        # No motion at reset: all three rod channels show the same pose.
         return base.replace(
-            inner=(theta, theta_dot),
-            obs=cls._obs(theta, theta, jnp.zeros((cls.act_dim,))),
+            inner=(theta, theta_dot, jnp.stack([theta, theta])),
+            obs=cls._obs((theta, theta, theta), jnp.zeros((cls.act_dim,))),
         )
 
     @classmethod
     def step(cls, state: EnvState, action: jax.Array):
-        theta, theta_dot = state.inner
-        flat = state.replace(obs=PendulumJax._obs(theta, theta_dot))
+        theta, theta_dot, hist = state.inner  # hist = (theta_{t-2}, theta_{t-1})
+        flat = state.replace(
+            inner=(theta, theta_dot), obs=PendulumJax._obs(theta, theta_dot)
+        )
         next_flat, out = PendulumJax.step(flat, action)
-        n_theta, _ = next_flat.inner  # post-auto-reset pose when ended
+        n_theta, n_theta_dot = next_flat.inner  # post-auto-reset pose when ended
         # Pre-reset pose, recovered from the flat pre-reset observation
         # (on episode end next_flat already holds the FRESH state):
         # rendering is 2pi-periodic, so atan2(sin, cos) is exact here.
         stepped_theta = jnp.arctan2(out.next_obs[1], out.next_obs[0])
-        # Pre-reset observation (what replay stores): motion from the
-        # pre-step pose, features = the action just taken.
-        stepped_obs = cls._obs(theta, stepped_theta, action)
+        # Pre-reset observation (what replay stores): poses at
+        # (t-1, t, t+1), features = the action just taken.
+        stepped_obs = cls._obs((hist[1], theta, stepped_theta), action)
         # Post-(auto)reset observation: a fresh episode starts with no
         # motion and no previous action.
-        fresh_obs = cls._obs(n_theta, n_theta, jnp.zeros((cls.act_dim,)))
+        fresh_obs = cls._obs(
+            (n_theta, n_theta, n_theta), jnp.zeros((cls.act_dim,))
+        )
         next_obs = jax.tree_util.tree_map(
             lambda a, b: jnp.where(out.ended, a, b), fresh_obs, stepped_obs
         )
+        # Invariant: hist always holds the two poses BEHIND the state's
+        # current pose — after the step that is (theta_{t-1}, theta_t).
+        next_hist = jnp.where(
+            out.ended,
+            jnp.stack([n_theta, n_theta]),
+            jnp.stack([hist[1], theta]),
+        )
         return (
-            next_flat.replace(obs=next_obs),
+            next_flat.replace(
+                inner=(n_theta, n_theta_dot, next_hist), obs=next_obs
+            ),
             out.replace(next_obs=stepped_obs),
         )
 

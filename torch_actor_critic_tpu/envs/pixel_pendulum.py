@@ -12,11 +12,15 @@ on physics cheap enough to train to convergence on one CPU core.
 
 Honesty contract — the policy must control from PIXELS:
 
-- The frame is rendered from the Pendulum-v1 state: the rod drawn as a
-  thick line. The previous step's rod goes in channel 0 and the
-  current rod in channel 1, so angular velocity is observable from a
-  single frame (a single rod image would make the task partially
-  observed — velocity aliasing, not a vision test).
+- The frame is rendered from the Pendulum-v1 state: the rod drawn as
+  an ANTI-ALIASED thick line (edge intensity falls off linearly with
+  sub-pixel distance, so pose is observable below the pixel grid — a
+  binary raster quantizes small angular velocities to zero: at
+  theta_dot=0.5 the rod tip moves ~0.3 px/step, invisible in a hard
+  mask). Channels hold the rod at t-2, t-1 and t, so angular velocity
+  AND its trend are observable from a single frame (a single rod image
+  would make the task partially observed — velocity aliasing, not a
+  vision test).
 - ``features`` carries ONLY the previous action (standard in pixel RL:
   it is part of the dynamics' information state and contains zero
   state the pixels don't already show). Angle and velocity never
@@ -40,32 +44,46 @@ import numpy as np
 from torch_actor_critic_tpu.core.types import MultiObservation
 
 SIZE = 32  # frame is SIZE x SIZE x 3
-ROD_HALF_WIDTH = 1.2  # px; rasterized by distance-to-segment
+ROD_HALF_WIDTH = 1.5  # px; rasterized by distance-to-segment
 ROD_LEN_FRAC = 0.42  # rod length as a fraction of frame size
 
 
 def render_rod(theta: float, size: int = SIZE) -> np.ndarray:
     """Rasterize the pendulum rod at angle ``theta`` into a uint8
-    ``(size, size)`` mask (255 on the rod, 0 elsewhere).
+    ``(size, size)`` image: 255 inside the rod, a linear anti-aliased
+    falloff over the one-pixel edge band, 0 beyond it.
+
+    Anti-aliasing is load-bearing, not cosmetic: the edge gradient
+    encodes the rod's SUB-PIXEL pose, which is what makes small
+    angular velocities observable from frame differences (a hard
+    binary mask quantizes the pose to the pixel grid and erases them).
 
     Pendulum-v1 measures ``theta`` from upright, counter-clockwise
     positive; image rows grow downward, so the tip of the upright rod
-    (theta=0) sits above the pivot at row < center.
+    (theta=0) sits above the pivot at row < center. Computed in
+    float32 to stay bit-identical to the jnp twin
+    (:func:`render_rod_jax`).
     """
     c = (size - 1) / 2.0
     length = size * ROD_LEN_FRAC
-    tip = np.array([c - length * np.cos(theta), c + length * np.sin(theta)])
-    pivot = np.array([c, c])
-    rows, cols = np.mgrid[0:size, 0:size].astype(np.float64)
+    theta32 = np.float32(theta)
+    tip = np.array(
+        [c - length * np.cos(theta32), c + length * np.sin(theta32)],
+        np.float32,
+    )
+    pivot = np.array([c, c], np.float32)
+    rows, cols = np.mgrid[0:size, 0:size].astype(np.float32)
     p = np.stack([rows, cols], axis=-1)  # (size, size, 2)
     seg = tip - pivot
-    seg_len2 = float(seg @ seg)
-    # Project every pixel onto the segment, clamp to it, threshold the
-    # distance: a vectorized thick-line draw with no drawing library.
-    t = np.clip(((p - pivot) @ seg) / seg_len2, 0.0, 1.0)
+    seg_len2 = np.float32(seg @ seg)
+    # Project every pixel onto the segment, clamp to it, and shade by
+    # distance: a vectorized anti-aliased thick-line draw with no
+    # drawing library.
+    t = np.clip(((p - pivot) @ seg) / seg_len2, np.float32(0), np.float32(1))
     closest = pivot + t[..., None] * seg
-    dist = np.linalg.norm(p - closest, axis=-1)
-    return np.where(dist <= ROD_HALF_WIDTH, 255, 0).astype(np.uint8)
+    dist = np.sqrt(np.sum((p - closest) ** 2, axis=-1))
+    shade = np.clip(ROD_HALF_WIDTH + 1.0 - dist, 0.0, 1.0)
+    return np.round(shade * 255).astype(np.uint8)
 
 
 def render_rod_jax(theta: jax.Array, size: int = SIZE) -> jax.Array:
@@ -78,10 +96,11 @@ def render_rod_jax(theta: jax.Array, size: int = SIZE) -> jax.Array:
     """
     c = (size - 1) / 2.0
     length = size * ROD_LEN_FRAC
+    theta32 = jnp.float32(theta)
     tip = jnp.stack(
-        [c - length * jnp.cos(theta), c + length * jnp.sin(theta)]
+        [c - length * jnp.cos(theta32), c + length * jnp.sin(theta32)]
     )
-    pivot = jnp.array([c, c])
+    pivot = jnp.array([c, c], jnp.float32)
     rows = jax.lax.broadcasted_iota(jnp.float32, (size, size), 0)
     cols = jax.lax.broadcasted_iota(jnp.float32, (size, size), 1)
     p = jnp.stack([rows, cols], axis=-1)
@@ -89,8 +108,9 @@ def render_rod_jax(theta: jax.Array, size: int = SIZE) -> jax.Array:
     seg_len2 = jnp.sum(seg * seg)
     t_par = jnp.clip(((p - pivot) @ seg) / seg_len2, 0.0, 1.0)
     closest = pivot + t_par[..., None] * seg
-    dist = jnp.linalg.norm(p - closest, axis=-1)
-    return jnp.where(dist <= ROD_HALF_WIDTH, 255, 0).astype(jnp.uint8)
+    dist = jnp.sqrt(jnp.sum((p - closest) ** 2, axis=-1))
+    shade = jnp.clip(ROD_HALF_WIDTH + 1.0 - dist, 0.0, 1.0)
+    return jnp.round(shade * 255).astype(jnp.uint8)
 
 
 class PixelPendulum:
@@ -110,7 +130,8 @@ class PixelPendulum:
             features=jax.ShapeDtypeStruct((self.act_dim,), jnp.float32),
             frame=jax.ShapeDtypeStruct((size, size, 3), jnp.uint8),
         )
-        self._prev_rod = np.zeros((size, size), np.uint8)
+        # The three temporal channels' rods: (t-2, t-1, t).
+        self._rods = [np.zeros((size, size), np.uint8)] * 3
         self._last_action = np.zeros(self.act_dim, np.float32)
 
     # ------------------------------------------------------------ internals
@@ -119,12 +140,10 @@ class PixelPendulum:
         theta, _ = self.env.unwrapped.state
         return float(theta)
 
-    def _obs(self, rod: np.ndarray) -> MultiObservation:
-        frame = np.zeros((self.size, self.size, 3), np.uint8)
-        frame[..., 0] = self._prev_rod  # where the rod was
-        frame[..., 1] = rod  # where the rod is
+    def _obs(self) -> MultiObservation:
         return MultiObservation(
-            features=self._last_action.copy(), frame=frame
+            features=self._last_action.copy(),
+            frame=np.stack(self._rods, axis=-1),
         )
 
     # ------------------------------------------------------------- protocol
@@ -132,22 +151,24 @@ class PixelPendulum:
     def reset(self, seed: int | None = None) -> MultiObservation:
         self.env.reset(seed=seed)
         rod = render_rod(self._theta(), self.size)
-        # No motion yet: both channels show the same rod.
-        self._prev_rod = rod
+        # No motion yet: all three channels show the same rod.
+        self._rods = [rod, rod, rod]
         self._last_action = np.zeros(self.act_dim, np.float32)
-        return self._obs(rod)
+        return self._obs()
 
     def step(self, action: np.ndarray):
-        prev_rod = render_rod(self._theta(), self.size)
         _, reward, terminated, truncated, _ = self.env.step(
             np.asarray(action, np.float32)
         )
-        self._prev_rod = prev_rod
+        self._rods = [
+            self._rods[1],
+            self._rods[2],
+            render_rod(self._theta(), self.size),
+        ]
         self._last_action = np.asarray(action, np.float32).reshape(
             self.act_dim
         )
-        rod = render_rod(self._theta(), self.size)
-        return self._obs(rod), float(reward), bool(terminated), bool(truncated)
+        return self._obs(), float(reward), bool(terminated), bool(truncated)
 
     def sample_action(self) -> np.ndarray:
         return np.asarray(self.env.action_space.sample(), np.float32)
