@@ -1,0 +1,71 @@
+"""Image augmentation for pixel RL: DrQ random shift.
+
+Vanilla SAC from pixels is notoriously unstable/sample-inefficient;
+random-shift augmentation of the replayed frames is the standard,
+minimal fix (Kostrikov et al., "Image Augmentation Is All You Need"
+[DrQ] — PAPERS.md): pad the frame by a few pixels (edge-replicate) and
+crop back at a random offset, independently per example and per use.
+The reference has no augmentation (or pixel-learning evidence) at all;
+this is a gated extension (``SACConfig.frame_augment``, default
+``"none"`` = parity).
+
+Everything here is jit-compatible (static shapes, ``dynamic_slice``
+crops) and runs inside the fused update burst — augmentation happens
+on device at sample time, so the replay buffer keeps storing each
+frame once, unaugmented.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torch_actor_critic_tpu.core.types import Batch, MultiObservation
+
+__all__ = ["random_shift", "augment_batch"]
+
+
+def random_shift(frames: jax.Array, key: jax.Array, pad: int = 4) -> jax.Array:
+    """DrQ random-shift: edge-pad by ``pad`` px, crop at a per-example
+    uniform offset in ``[0, 2*pad]``. Works on ``(..., B, H, W, C)``
+    frames of any dtype (uint8 replay frames stay uint8 — shifting
+    moves bytes, no arithmetic).
+    """
+    *lead, h, w, c = frames.shape
+    flat = frames.reshape((-1, h, w, c))
+    padded = jnp.pad(
+        flat, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="edge"
+    )
+    offsets = jax.random.randint(key, (flat.shape[0], 2), 0, 2 * pad + 1)
+
+    def crop(img, off):
+        return jax.lax.dynamic_slice(img, (off[0], off[1], 0), (h, w, c))
+
+    return jax.vmap(crop)(padded, offsets).reshape(frames.shape)
+
+
+def augment_batch(
+    batch: Batch, key: jax.Array, mode: str, pad: int = 4
+) -> Batch:
+    """Apply the configured augmentation to a sampled visual batch.
+
+    ``mode="none"`` (parity) returns the batch untouched — including
+    for flat/sequence observations, where there is nothing to augment.
+    ``mode="shift"`` random-shifts ``states.frame`` and
+    ``next_states.frame`` with independent offsets (DrQ's K=M=1
+    scheme). Called inside the jitted update, so the augmentation is
+    re-drawn every gradient step as DrQ prescribes.
+    """
+    if mode == "none" or not isinstance(batch.states, MultiObservation):
+        return batch
+    if mode != "shift":
+        raise ValueError(f"unknown frame_augment mode {mode!r}")
+    k_s, k_n = jax.random.split(key)
+    return batch.replace(
+        states=batch.states.replace(
+            frame=random_shift(batch.states.frame, k_s, pad)
+        ),
+        next_states=batch.next_states.replace(
+            frame=random_shift(batch.next_states.frame, k_n, pad)
+        ),
+    )

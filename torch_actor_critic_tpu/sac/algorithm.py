@@ -33,6 +33,7 @@ from flax import linen as nn
 from torch_actor_critic_tpu.buffer.replay import push, sample
 from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
 from torch_actor_critic_tpu.ops.polyak import polyak_update
+from torch_actor_critic_tpu.ops.augment import augment_batch
 from torch_actor_critic_tpu.sac import losses
 from torch_actor_critic_tpu.utils.config import SACConfig
 
@@ -135,7 +136,16 @@ class SAC:
         misordering at ``sac/algorithm.py:155-156``).
         """
         cfg = self.config
-        rng, key_q, key_pi = jax.random.split(state.rng, 3)
+        if cfg.frame_augment != "none":
+            rng, key_q, key_pi, key_aug = jax.random.split(state.rng, 4)
+            batch = augment_batch(
+                batch, key_aug, cfg.frame_augment, cfg.augment_pad
+            )
+        else:
+            # Parity path keeps the historical 3-way split: 'none' must
+            # reproduce pre-augmentation streams bit-for-bit (resumed
+            # checkpoints, recorded evidence runs).
+            rng, key_q, key_pi = jax.random.split(state.rng, 3)
         alpha = (
             jnp.exp(jax.lax.stop_gradient(state.log_alpha))
             if cfg.learn_alpha

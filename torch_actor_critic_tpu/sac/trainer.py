@@ -63,6 +63,16 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
     replacement of the reference's env-name string dispatch
     (ref ``main.py:63-90``)."""
     dtype = config.model_dtype
+    if config.frame_augment != "none" and not isinstance(
+        env.obs_spec, MultiObservation
+    ):
+        # Fail-at-construction policy (see SACConfig.__post_init__): a
+        # frame augmentation silently no-opping on flat/sequence
+        # observations would let a user believe DrQ was active.
+        raise ValueError(
+            f"frame_augment={config.frame_augment!r} requires a visual "
+            f"(frame) observation; got obs spec {env.obs_spec}"
+        )
     if config.algorithm == "td3":
         # TD3 (extension): deterministic tanh policy over the flat MLP
         # or visual stack (same twin critics as SAC). The sequence

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import optax
 from flax import linen as nn
 
+from torch_actor_critic_tpu.ops.augment import augment_batch
 from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
 from torch_actor_critic_tpu.ops.polyak import polyak_update
 from torch_actor_critic_tpu.sac.algorithm import Metrics, run_update_burst
@@ -126,7 +127,15 @@ class TD3:
         docstring for why this beats ``lax.cond`` under ``shard_map``.
         """
         cfg = self.config
-        rng, key_q = jax.random.split(state.rng)
+        if cfg.frame_augment != "none":
+            rng, key_q, key_aug = jax.random.split(state.rng, 3)
+            batch = augment_batch(
+                batch, key_aug, cfg.frame_augment, cfg.augment_pad
+            )
+        else:
+            # Parity path: keep the historical 2-way split (see the
+            # matching note in sac/algorithm.py).
+            rng, key_q = jax.random.split(state.rng)
 
         # --- critic step (every step) ---
         (loss_q, q_aux), q_grads = jax.value_and_grad(

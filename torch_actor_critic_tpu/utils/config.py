@@ -72,6 +72,11 @@ class SACConfig:
     strides: t.Tuple[int, ...] = (4, 2, 1)
     cnn_features: int = 1  # 1 == reference scalar-vision bottleneck
     cnn_dense_size: int = 512  # conv-trunk dense width (ref convolutional.py:36)
+    # DrQ random-shift frame augmentation in the update path (pixel-RL
+    # stabilizer, ops/augment.py). "none" = parity (the reference has
+    # no augmentation); "shift" = DrQ K=M=1.
+    frame_augment: str = "none"
+    augment_pad: int = 4
     normalize_pixels: bool = False
 
     # Sequence-policy extension: history_len > 1 wraps the env in a
@@ -172,6 +177,15 @@ class SACConfig:
                 "learn_alpha and parity_pi_obs are SAC-only options; "
                 "algorithm='td3' has no entropy temperature and no "
                 "pi-loss observation quirk"
+            )
+        if self.frame_augment not in ("none", "shift"):
+            raise ValueError(
+                "frame_augment must be 'none' or 'shift', got "
+                f"{self.frame_augment!r}"
+            )
+        if self.augment_pad < 1:
+            raise ValueError(
+                f"augment_pad must be >= 1, got {self.augment_pad}"
             )
         if self.burst_unroll < 0:
             raise ValueError(
