@@ -469,3 +469,41 @@ class TestPixelPendulumJax:
 
         with pytest.raises(ValueError, match="pytree"):
             history_env(PixelPendulumJax, 8)
+
+
+def test_fused_loop_runs_td3_and_td3_visual():
+    """The fused on-device loop is algorithm-agnostic: TD3 (delayed
+    updates inside the burst scan) runs through make_learner unchanged,
+    flat AND visual (on-chip-rendered pixel env + deterministic visual
+    actor). Pinned so the shared-machinery property cannot regress."""
+    from torch_actor_critic_tpu.envs.ondevice import (
+        PendulumJax,
+        PixelPendulumJax,
+    )
+    from torch_actor_critic_tpu.sac.trainer import build_models, make_learner
+    from torch_actor_critic_tpu.sac.ondevice import OnDeviceLoop, _SpecView
+
+    for env_cls, extra in (
+        (PendulumJax, {}),
+        (
+            PixelPendulumJax,
+            dict(filters=(8, 16), kernel_sizes=(4, 3), strides=(2, 2),
+                 cnn_dense_size=32, cnn_features=8, normalize_pixels=True),
+        ),
+    ):
+        cfg = SACConfig(
+            algorithm="td3", hidden_sizes=(16, 16), batch_size=8, **extra
+        )
+        actor, critic = build_models(cfg, _SpecView(env_cls))
+        learner = make_learner(cfg, actor, critic, env_cls.act_dim)
+        loop = OnDeviceLoop(learner, env_cls, n_envs=4)
+        ts, buf, es, key = loop.init(jax.random.key(0), buffer_capacity=1000)
+        ts, buf, es, key, _ = loop.epoch(
+            ts, buf, es, key, steps=25, update_every=25, warmup=True
+        )
+        ts, buf, es, key, m = loop.epoch(
+            ts, buf, es, key, steps=25, update_every=25
+        )
+        assert int(ts.step) == 25, env_cls.__name__
+        assert np.isfinite(float(m["loss_q"])), env_cls.__name__
+        assert np.isfinite(float(m["loss_pi"])), env_cls.__name__
