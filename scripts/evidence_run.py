@@ -98,13 +98,26 @@ PRESETS = {
     # and at the widened extension. Conv geometry sized for the 32x32
     # frames the same way the Atari defaults size 64x64.
     "pixelpend-parity": _preset(
-        "PixelPendulum-v0", epochs=8, steps_per_epoch=4000, max_ep_len=1000,
+        "PixelPendulum-v0", epochs=5, steps_per_epoch=4000, max_ep_len=1000,
         buffer_size=32_000,
         filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
         cnn_dense_size=128, cnn_features=1, normalize_pixels=False,
     ),
+    # Widened extension run with the framework's pixel-RL recipe:
+    # DrQ random-shift augmentation + learned temperature (vanilla
+    # pixel SAC is the known-unstable baseline — the pixelpend-vanilla
+    # control records it).
     "pixelpend-wide": _preset(
         "PixelPendulum-v0", epochs=8, steps_per_epoch=4000, max_ep_len=1000,
+        buffer_size=32_000,
+        filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
+        cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
+        frame_augment="shift", learn_alpha=True,
+    ),
+    # Vanilla control: widened vision, NO augmentation, fixed alpha —
+    # isolates what the DrQ recipe adds.
+    "pixelpend-vanilla": _preset(
+        "PixelPendulum-v0", epochs=5, steps_per_epoch=4000, max_ep_len=1000,
         buffer_size=32_000,
         filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
         cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
