@@ -628,11 +628,15 @@ def bench_attention(budget_s=180.0, t=2048, block_sweep=False):
             # native lane layout; 64 keeps a d=64 head at true width
             # (half the q/k/v/o HBM traffic — the MXU is 50%-bounded
             # at d=64 either way, see SCALING.md's attention roofline).
+            # Decision-relevant points first (the stage budget may
+            # truncate the tail): the incumbent (512,512,128) and the
+            # round-4 candidates, then the historical small blocks.
             sweep = []
             for bq, bk, lanes in (
-                (128, 256, 128), (256, 256, 128), (256, 512, 128),
-                (512, 512, 128), (512, 1024, 128), (1024, 1024, 128),
-                (512, 512, 64), (1024, 1024, 64),
+                (512, 512, 128), (512, 512, 64),
+                (1024, 1024, 128), (1024, 1024, 64),
+                (512, 1024, 128), (256, 512, 128),
+                (256, 256, 128), (128, 256, 128),
             ):
                 if time.time() - t_start > budget_s:
                     break
@@ -1113,9 +1117,9 @@ _STAGES = {
     # Two sequence lengths: the O(block)-memory kernel's scaling story —
     # 4x the length = 16x the FLOPs at flat VMEM residency.
     "attention": lambda: {
-        # 2k carries the block sweep (4 extra Pallas compiles); the
-        # budgets must fit the stage timeout (900s) together.
-        "attention": bench_attention(budget_s=480.0, t=2048,
+        # 2k carries the block sweep (8 extra Pallas fwd+bwd compiles);
+        # the budgets must fit the stage timeout (1200s) together.
+        "attention": bench_attention(budget_s=780.0, t=2048,
                                      block_sweep=True),
         "attention_8k": bench_attention(budget_s=240.0, t=8192),
     },

@@ -83,7 +83,7 @@ def main() -> int:
         ("td3", 420),
         ("visual", 480),
         ("on_device", 540),
-        ("attention", 900),
+        ("attention", 1200),
     ):
         res = bench.run_stage_subprocess(stage, timeout_s, diagnostics, platform)
         if res and "acc_sps_bf16" in res:
