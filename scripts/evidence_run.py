@@ -122,6 +122,30 @@ PRESETS = {
         filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
         cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
     ),
+    # Balance-start pixel task (stabilization, not swing-up
+    # discovery): the learning signal is reachable within a CPU-budget
+    # run, so this trio carries the committed learning-curve proof —
+    # DrQ recipe vs vanilla vs the reference's cnn_features=1 scalar
+    # bottleneck (same configs as the pixelpend-* swing-up runs).
+    "pixelbal-wide": _preset(
+        "PixelPendulumBalance-v0", epochs=6, steps_per_epoch=4000,
+        max_ep_len=1000, buffer_size=24_000,
+        filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
+        cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
+        frame_augment="shift", learn_alpha=True,
+    ),
+    "pixelbal-vanilla": _preset(
+        "PixelPendulumBalance-v0", epochs=4, steps_per_epoch=4000,
+        max_ep_len=1000, buffer_size=16_000,
+        filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
+        cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
+    ),
+    "pixelbal-parity": _preset(
+        "PixelPendulumBalance-v0", epochs=4, steps_per_epoch=4000,
+        max_ep_len=1000, buffer_size=16_000,
+        filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
+        cnn_dense_size=128, cnn_features=1, normalize_pixels=False,
+    ),
     # Real composer wall-runner epoch (PARITY.md "Pixel wall-runner
     # end-to-end"; BASELINE config 5 geometry)
     "wallrunner-real": _preset(

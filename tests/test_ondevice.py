@@ -507,3 +507,20 @@ def test_fused_loop_runs_td3_and_td3_visual():
         assert int(ts.step) == 25, env_cls.__name__
         assert np.isfinite(float(m["loss_q"])), env_cls.__name__
         assert np.isfinite(float(m["loss_pi"])), env_cls.__name__
+
+
+def test_balance_twin_resets_near_upright_including_auto_reset():
+    from torch_actor_critic_tpu.envs.ondevice import PixelPendulumBalanceJax
+
+    for i in range(5):
+        st = PixelPendulumBalanceJax.reset(jax.random.key(i))
+        assert abs(float(st.inner[0])) < 0.15 * np.pi + 1e-6
+    # The auto-reset inside step must use the SUBCLASS distribution
+    # (routed through cls.reset), not the base full-circle one.
+    st = PixelPendulumBalanceJax.reset(jax.random.key(7))
+    step = jax.jit(PixelPendulumBalanceJax.step)
+    a = jnp.array([0.0])
+    for _ in range(PixelPendulumBalanceJax.max_episode_steps):
+        st, out = step(st, a)
+    assert bool(out.ended)
+    assert abs(float(st.inner[0])) < 0.15 * np.pi + 1e-6

@@ -390,13 +390,28 @@ class PixelPendulumJax:
         )
 
     @classmethod
+    def _sample_pose(cls, key: jax.Array):
+        """Initial (theta, theta_dot) draw — the ONLY variation point
+        subclasses override (reset and the in-step auto-reset both
+        route through it). Base: the full-circle Pendulum-v1 reset
+        distribution."""
+        k_theta, k_vel = jax.random.split(key)
+        return (
+            jax.random.uniform(k_theta, (), minval=-jnp.pi, maxval=jnp.pi),
+            jax.random.uniform(k_vel, (), minval=-1.0, maxval=1.0),
+        )
+
+    @classmethod
     def reset(cls, key: jax.Array) -> EnvState:
-        base = PendulumJax.reset(key)
-        theta, theta_dot = base.inner
+        k_pose, k_next = jax.random.split(key)
+        theta, theta_dot = cls._sample_pose(k_pose)
         # No motion at reset: all three rod channels show the same pose.
-        return base.replace(
+        return EnvState(
             inner=(theta, theta_dot, jnp.stack([theta, theta])),
             obs=cls._obs((theta, theta, theta), jnp.zeros((cls.act_dim,))),
+            step_count=jnp.int32(0),
+            episode_return=jnp.float32(0.0),
+            rng=k_next,
         )
 
     @classmethod
@@ -407,6 +422,16 @@ class PixelPendulumJax:
         )
         next_flat, out = PendulumJax.step(flat, action)
         n_theta, n_theta_dot = next_flat.inner  # post-auto-reset pose when ended
+        # Route the auto-reset pose through _sample_pose so subclasses
+        # with a different reset distribution (the balance-start
+        # variant) get THEIR fresh pose — two scalars, not a discarded
+        # EnvState. The fold_in constant keeps this draw off the k_next
+        # stream next_flat's bookkeeping rng advanced on.
+        f_theta, f_theta_dot = cls._sample_pose(
+            jax.random.fold_in(state.rng, 0x9A1)
+        )
+        n_theta = jnp.where(out.ended, f_theta, n_theta)
+        n_theta_dot = jnp.where(out.ended, f_theta_dot, n_theta_dot)
         # Pre-reset pose, recovered from the flat pre-reset observation
         # (on episode end next_flat already holds the FRESH state):
         # rendering is 2pi-periodic, so atan2(sin, cos) is exact here.
@@ -437,6 +462,25 @@ class PixelPendulumJax:
         )
 
 
+class PixelPendulumBalanceJax(PixelPendulumJax):
+    """Balance-start variant (on-device twin of
+    ``PixelPendulumBalance-v0``): resets near upright, so the pixel
+    task is stabilization — the learning signal is reachable within a
+    short budget (see the host env's docstring for the honest framing
+    vs full swing-up). Only the pose distribution differs; reset AND
+    the in-step auto-reset inherit it via ``_sample_pose``."""
+
+    @classmethod
+    def _sample_pose(cls, key: jax.Array):
+        k_theta, k_vel = jax.random.split(key)
+        return (
+            jax.random.uniform(
+                k_theta, (), minval=-0.15 * jnp.pi, maxval=0.15 * jnp.pi
+            ),
+            jax.random.uniform(k_vel, (), minval=-0.2, maxval=0.2),
+        )
+
+
 ON_DEVICE_ENVS = {
     "Pendulum-v1": PendulumJax,
     "HalfCheetah-v3": CheetahRunJax,
@@ -444,6 +488,7 @@ ON_DEVICE_ENVS = {
     "HalfCheetah-v5": CheetahRunJax,
     "cheetah-run-jax": CheetahRunJax,
     "PixelPendulum-v0": PixelPendulumJax,
+    "PixelPendulumBalance-v0": PixelPendulumBalanceJax,
 }
 
 # On-device twins whose *dynamics* are a surrogate, not physics-parity

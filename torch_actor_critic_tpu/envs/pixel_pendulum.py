@@ -114,15 +114,35 @@ def render_rod_jax(theta: jax.Array, size: int = SIZE) -> jax.Array:
 
 
 class PixelPendulum:
-    """Pendulum-v1 with pixel observations (framework env protocol)."""
+    """Pendulum-v1 with pixel observations (framework env protocol).
+
+    ``balance=True`` is the ``PixelPendulumBalance-v0`` variant: resets
+    start near upright (theta ~ U(±0.15pi), theta_dot ~ U(±0.2)) so the
+    task is stabilization, not swing-up discovery. Same physics, same
+    reward, same pixels-only honesty contract — but the learning
+    signal is reachable within a CPU-budget run: a random policy falls
+    immediately (~-1000/episode) while a competent one holds ~-100, and
+    improvement is incremental (staying up longer pays immediately)
+    instead of gated on discovering the full swing-up. Swing-up from
+    pixels at the DrQ recipe needs ~100k+ env steps (Kostrikov et al.
+    2020 report dm_control pendulum swingup solving around the 100k
+    benchmark tier) — the committed `pixelpend-wide` curve documents
+    that budget honestly.
+    """
 
     name = "PixelPendulum-v0"
 
-    def __init__(self, seed: int | None = None, size: int = SIZE):
+    def __init__(
+        self, seed: int | None = None, size: int = SIZE,
+        balance: bool = False,
+    ):
         import gymnasium
 
         self.env = gymnasium.make("Pendulum-v1")
         self.env.action_space.seed(seed)
+        self.balance = balance
+        if balance:
+            self.name = "PixelPendulumBalance-v0"
         self.size = size
         self.act_dim = int(self.env.action_space.shape[0])
         self.act_limit = float(self.env.action_space.high[0])
@@ -150,6 +170,14 @@ class PixelPendulum:
 
     def reset(self, seed: int | None = None) -> MultiObservation:
         self.env.reset(seed=seed)
+        if self.balance:
+            # Near-upright start, drawn from the env's own (seeded)
+            # generator so seeded resets stay reproducible.
+            rng = self.env.unwrapped.np_random
+            self.env.unwrapped.state = np.array([
+                rng.uniform(-0.15 * np.pi, 0.15 * np.pi),
+                rng.uniform(-0.2, 0.2),
+            ])
         rod = render_rod(self._theta(), self.size)
         # No motion yet: all three channels show the same rod.
         self._rods = [rod, rod, rod]

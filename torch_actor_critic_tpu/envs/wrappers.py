@@ -220,6 +220,10 @@ def make_env(name: str, seed: int | None = None, **kwargs):
         from torch_actor_critic_tpu.envs.pixel_pendulum import PixelPendulum
 
         return PixelPendulum(seed=seed, **kwargs)
+    if name == "PixelPendulumBalance-v0":
+        from torch_actor_critic_tpu.envs.pixel_pendulum import PixelPendulum
+
+        return PixelPendulum(seed=seed, balance=True, **kwargs)
     if name.startswith("dm:"):
         _, domain, task = name.split(":")
         return DmControlEnv(domain, task, seed=seed)
@@ -229,4 +233,8 @@ def make_env(name: str, seed: int | None = None, **kwargs):
 def is_visual_env(name: str) -> bool:
     """Mixed-observation envs need the visual model/buffer stack
     (ref string dispatch at ``main.py:63,105``)."""
-    return name in ("DeepMindWallRunner-v0", "PixelPendulum-v0")
+    return name in (
+        "DeepMindWallRunner-v0",
+        "PixelPendulum-v0",
+        "PixelPendulumBalance-v0",
+    )
