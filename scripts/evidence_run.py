@@ -146,6 +146,22 @@ PRESETS = {
         filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
         cnn_dense_size=128, cnn_features=1, normalize_pixels=False,
     ),
+    # dm_control cheetah at 100k (PARITY.md "dm:cheetah:run"
+    # comparison): the reference-default fixed alpha fails silently on
+    # [0,1]-per-step rewards; the learned temperature and TD3 recover.
+    "dmcheetah-fixed": _preset(
+        "dm:cheetah:run", epochs=20, steps_per_epoch=5000, max_ep_len=1000,
+        buffer_size=100_000,
+    ),
+    "dmcheetah-learnalpha": _preset(
+        "dm:cheetah:run", epochs=20, steps_per_epoch=5000, max_ep_len=1000,
+        buffer_size=100_000, learn_alpha=True,
+    ),
+    "dmcheetah-td3": _preset(
+        "dm:cheetah:run", epochs=20, steps_per_epoch=5000, max_ep_len=1000,
+        buffer_size=100_000, algorithm="td3",
+        start_steps=10_000, update_after=1000,
+    ),
     # Real composer wall-runner epoch (PARITY.md "Pixel wall-runner
     # end-to-end"; BASELINE config 5 geometry)
     "wallrunner-real": _preset(
