@@ -137,8 +137,8 @@ PRESETS = {
     # Longer-budget headline run (the 24k curve was still improving
     # every epoch when its budget ended): same recipe, 40k steps.
     "pixelbal-long": _preset(
-        "PixelPendulumBalance-v0", epochs=10, steps_per_epoch=4000,
-        max_ep_len=1000, buffer_size=40_000,
+        "PixelPendulumBalance-v0", epochs=8, steps_per_epoch=4000,
+        max_ep_len=1000, buffer_size=32_000,
         filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
         cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
         frame_augment="shift", learn_alpha=True,
