@@ -31,6 +31,9 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+from torch_actor_critic_tpu.sac.ondevice import PIXEL_CONV, PIXEL_RECIPE
+
+
 def _preset(env, seed=0, eval_episodes=10, **overrides):
     return {"env": env, "seed": seed, "eval_episodes": eval_episodes,
             "overrides": overrides}
@@ -110,17 +113,14 @@ PRESETS = {
     "pixelpend-wide": _preset(
         "PixelPendulum-v0", epochs=8, steps_per_epoch=4000, max_ep_len=1000,
         buffer_size=32_000,
-        filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
-        cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
-        frame_augment="shift", learn_alpha=True,
+        **PIXEL_RECIPE,
     ),
     # Vanilla control: widened vision, NO augmentation, fixed alpha —
     # isolates what the DrQ recipe adds.
     "pixelpend-vanilla": _preset(
         "PixelPendulum-v0", epochs=5, steps_per_epoch=4000, max_ep_len=1000,
         buffer_size=32_000,
-        filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
-        cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
+        **PIXEL_CONV,
     ),
     # Balance-start pixel task (stabilization, not swing-up
     # discovery): the learning signal is reachable within a CPU-budget
@@ -130,24 +130,19 @@ PRESETS = {
     "pixelbal-wide": _preset(
         "PixelPendulumBalance-v0", epochs=6, steps_per_epoch=4000,
         max_ep_len=1000, buffer_size=24_000,
-        filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
-        cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
-        frame_augment="shift", learn_alpha=True,
+        **PIXEL_RECIPE,
     ),
     # Longer-budget headline run (the 24k curve was still improving
     # every epoch when its budget ended): same recipe, 40k steps.
     "pixelbal-long": _preset(
         "PixelPendulumBalance-v0", epochs=8, steps_per_epoch=4000,
         max_ep_len=1000, buffer_size=32_000,
-        filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
-        cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
-        frame_augment="shift", learn_alpha=True,
+        **PIXEL_RECIPE,
     ),
     "pixelbal-vanilla": _preset(
         "PixelPendulumBalance-v0", epochs=4, steps_per_epoch=4000,
         max_ep_len=1000, buffer_size=16_000,
-        filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
-        cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
+        **PIXEL_CONV,
     ),
     "pixelbal-parity": _preset(
         "PixelPendulumBalance-v0", epochs=4, steps_per_epoch=4000,

@@ -9,17 +9,26 @@ through the real eval CLI (``run_agent.py``). This closes the loop the
 throughput bench cannot: a policy trained *entirely on the chip*
 controls the real host environment.
 
-Writes ``runs/tpu/train_proof_<utc>.json`` incrementally (training
-result first, eval appended), so a tunnel death mid-proof keeps the
-training half. Run by ``scripts/tpu_watch.sh`` when no proof artifact
-exists yet, and manually any time:
+Two proof families, selected by ``--task``:
 
-    python scripts/tpu_train_proof.py [--epochs 5] [--steps-per-epoch 4000]
+- ``pendulum`` (default, 5 epochs): flat SAC on the exact-dynamics
+  Pendulum twin; artifact ``runs/tpu/train_proof_<utc>.json``. Solved
+  = eval > -350 (host parity band: torch -120.3, our host loop
+  -119.4).
+- ``pixel`` (30 epochs): visual SAC with the shared DrQ recipe
+  (``sac/ondevice.PIXEL_RECIPE``) on the on-chip-rendered
+  ``PixelPendulumBalance`` twin; artifact
+  ``runs/tpu/train_proof_pixel_<utc>.json``. Solved = eval > -400
+  (measured random policy -873.7; the CPU-budget curves in
+  ``runs/pixelbal-*`` plateau ~-770 — this is the pixel-learning
+  demonstration only the chip's throughput can reach).
 
-The Pendulum twin has exact gymnasium dynamics (``envs/ondevice.py`` —
-not the cheetah surrogate), so the eval return is comparable to the
-host-trained parity band in PARITY.md (solved ~= better than -350;
-torch baseline -120.3, our host loop -119.4).
+Artifacts write incrementally (training result first, eval appended),
+so a tunnel death mid-proof keeps the training half. Run by
+``scripts/tpu_watch.sh`` while unsolved (pixel: max 3 attempts), and
+manually any time:
+
+    python scripts/tpu_train_proof.py [--task pixel] [--epochs N]
 """
 
 from __future__ import annotations
@@ -38,7 +47,16 @@ import bench  # noqa: E402
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument(
+        "--task", choices=["pendulum", "pixel"], default="pendulum",
+        help="pendulum: flat SAC on the exact-dynamics Pendulum twin. "
+        "pixel: visual SAC (DrQ recipe) on the on-chip-rendered "
+        "PixelPendulumBalance twin — the pixel-learning proof the CPU "
+        "budget cannot reach (runs/pixelbal-* curves improve ~200 "
+        "return over 32k steps but stay under-trained; the chip does "
+        "120k steps in minutes through the fused visual loop).",
+    )
+    p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--steps-per-epoch", type=int, default=4000)
     p.add_argument("--on-device-envs", type=int, default=4)
     p.add_argument("--eval-episodes", type=int, default=10)
@@ -58,6 +76,10 @@ def main(argv=None) -> int:
     if info.get("platform") in (None, "none"):
         info = {"platform": "cpu", "device_kind": "cpu"}
 
+    pixel = args.task == "pixel"
+    if args.epochs is None:
+        args.epochs = 30 if pixel else 5
+    env_name = "PixelPendulumBalance-v0" if pixel else "Pendulum-v1"
     stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     runs_root = "runs/train_proof"  # gitignored; only the JSON artifact is committed
     # A CPU self-test must not land in the committed chip-evidence tree
@@ -68,7 +90,8 @@ def main(argv=None) -> int:
     else:
         evidence_dir = bench.TPU_EVIDENCE_DIR
     os.makedirs(evidence_dir, exist_ok=True)
-    path = os.path.join(evidence_dir, f"train_proof_{stamp}.json")
+    prefix = "train_proof_pixel" if pixel else "train_proof"
+    path = os.path.join(evidence_dir, f"{prefix}_{stamp}.json")
     # Single source for the run configuration: the CLI args, the
     # artifact's config block, and the warmup accounting all derive
     # from this dict (reference model config, ref main.py:147-160).
@@ -83,12 +106,26 @@ def main(argv=None) -> int:
         "buffer_size": 100000,
         "seed": args.seed,
     }
+    if pixel:
+        # The ONE shared pixel recipe (sac/ondevice.PIXEL_RECIPE —
+        # same config the committed pixelbal-* evidence runs and the
+        # bench's pixel row use); tuples rendered as CLI csv.
+        from torch_actor_critic_tpu.sac.ondevice import PIXEL_RECIPE
+
+        train_cfg.update({
+            k: ",".join(map(str, v)) if isinstance(v, tuple) else v
+            for k, v in PIXEL_RECIPE.items()
+        })
     out = {
         "proof": "on-device training -> host-env eval (scripts/tpu_train_proof.py)",
         "backend": info.get("platform"),
         "device_kind": info.get("device_kind"),
         "captured_utc": stamp,
-        "env": "Pendulum-v1 (pure-JAX twin on chip; gymnasium on host eval)",
+        "env": (
+            f"{env_name} (pure-JAX twin on chip — pixel frames "
+            "rasterized on device; host env on eval)" if pixel else
+            "Pendulum-v1 (pure-JAX twin on chip; gymnasium on host eval)"
+        ),
         "config": dict(train_cfg),
     }
 
@@ -108,7 +145,7 @@ def main(argv=None) -> int:
 
     t0 = time.time()
     metrics = train_main([
-        "--environment", "Pendulum-v1",
+        "--environment", env_name,
         "--on-device", "true",
         "--devices", "1",
         "--runs-root", runs_root,
@@ -149,16 +186,22 @@ def main(argv=None) -> int:
         "--headless",
         "--seed", str(args.seed),
     ])
+    # Thresholds: flat Pendulum — host parity band (torch -120.3, ours
+    # -119.4), -350 leaves seed headroom. Pixel balance — the measured
+    # random policy is -873.7 and the CPU-budget runs plateau ~-770
+    # (PARITY.md "Pixel learning"); -400 means the chip-trained pixel
+    # policy holds the pendulum up most of the episode.
+    threshold = -400.0 if pixel else -350.0
     out["eval"] = {
         "episodes": args.eval_episodes,
         "ep_ret_mean": round(float(eval_metrics["ep_ret_mean"]), 1),
         "ep_ret_std": round(float(eval_metrics["ep_ret_std"]), 1),
-        "host_env": "gymnasium Pendulum-v1",
-        # Host-loop parity band for context (PARITY.md): torch -120.3,
-        # ours -119.4; "solved" leaves seed headroom.
-        "solved_band_threshold": -350.0,
-        "solved": float(eval_metrics["ep_ret_mean"]) > -350.0,
+        "host_env": env_name,
+        "solved_band_threshold": threshold,
+        "solved": float(eval_metrics["ep_ret_mean"]) > threshold,
     }
+    if pixel:
+        out["eval"]["random_policy_baseline"] = -873.7
     flush()
     print(f"[proof] eval on host env: {out['eval']['ep_ret_mean']} "
           f"(solved={out['eval']['solved']}) -> {path}")

@@ -35,6 +35,21 @@ from torch_actor_critic_tpu.sac.algorithm import SAC
 Metrics = t.Dict[str, jax.Array]
 
 
+# The pixel-task training recipe shared by every surface that trains or
+# times the 32x32 PixelPendulum family: the committed evidence runs
+# (scripts/evidence_run.py pixelbal-*/pixelpend-* presets), the on-chip
+# train proof (scripts/tpu_train_proof.py --task pixel), and
+# benchmark_on_device's pixel row. ONE definition so they cannot
+# silently measure different configs. Conv geometry sized for 32x32
+# frames (the Atari defaults need >=36px); DrQ shift + learned
+# temperature are the stabilizers the committed curves document.
+PIXEL_CONV = dict(
+    filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
+    cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
+)
+PIXEL_RECIPE = dict(PIXEL_CONV, frame_augment="shift", learn_alpha=True)
+
+
 class OnDeviceLoop:
     """Collect+update loop compiled end-to-end — one device or a mesh.
 
@@ -471,14 +486,11 @@ def benchmark_on_device(
     if env_cls is None:
         raise ValueError(f"no on-device twin for {env_name!r}")
     if hasattr(env_cls, "obs_spec"):
-        # Pixel twin: conv geometry sized for its 32x32 frames (the
-        # Atari defaults need >=36px), widened cnn_features — the
-        # configuration the committed pixelpend-wide learning run uses.
+        # Pixel twin: the shared recipe's conv geometry (augmentation
+        # irrelevant here — the bench times bursts, not learning).
         cfg = SACConfig(
             hidden_sizes=(256, 256), batch_size=64,
-            filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
-            cnn_dense_size=128, cnn_features=64, normalize_pixels=True,
-            history_len=history_len,
+            history_len=history_len, **PIXEL_CONV,
         )
     else:
         cfg = SACConfig(
