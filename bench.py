@@ -403,6 +403,93 @@ def bench_td3():
     return {"grad_steps_per_sec": round(run(60), 1), "algorithm": "td3"}
 
 
+def bench_population(budget_s=420.0):
+    """Population scaling at the reference config: N independent
+    learners vmapped into one burst (parallel/population.py).
+
+    The round-4 sweep proved the chip does 70% MFU at batch 8192 while
+    the product config runs ~1-2% (latency-bound at batch 64); this
+    stage measures how much of that idle MXU converts into extra SEEDS:
+    aggregate grad-steps/s (all members) vs the N=1 burst. Near-linear
+    scaling until the member matmuls fill the MXU is the design claim.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torch_actor_critic_tpu.core.types import Batch
+    from torch_actor_critic_tpu.parallel.population import PopulationLearner
+    from torch_actor_critic_tpu.sac.trainer import build_models, make_learner
+    from torch_actor_critic_tpu.utils.config import SACConfig
+    from torch_actor_critic_tpu.utils.sync import drain
+
+    cfg = SACConfig(batch_size=BATCH, hidden_sizes=HIDDEN)
+
+    class _Spec:
+        obs_spec = jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32)
+        act_limit = 1.0
+
+    _Spec.act_dim = ACT_DIM
+    actor, critic = build_models(cfg, _Spec)
+    sac = make_learner(cfg, actor, critic, ACT_DIM)
+    capacity = 20_000  # per member; keeps 128 members << HBM
+
+    out = []
+    t_start = time.time()
+    base_sps = None
+    for n_members in (1, 8, 32, 128):
+        if time.time() - t_start > budget_s:
+            break
+        entry = {"members": n_members}
+        try:
+            pop = PopulationLearner(sac, n_members)
+            state = pop.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
+            buffer = pop.init_buffer(
+                capacity, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32),
+                ACT_DIM,
+            )
+
+            def chunk(seed, n=BURST):
+                ks = jax.random.split(jax.random.key(seed), 5)
+                shp = (n_members, n)
+                return Batch(
+                    states=jax.random.normal(ks[0], shp + (OBS_DIM,)),
+                    actions=jnp.tanh(
+                        jax.random.normal(ks[1], shp + (ACT_DIM,))
+                    ),
+                    rewards=jax.random.normal(ks[2], shp),
+                    next_states=jax.random.normal(ks[3], shp + (OBS_DIM,)),
+                    done=jnp.zeros(shp),
+                )
+
+            buffer = pop.push_chunk(buffer, chunk(1, 2000))
+            state, buffer, m = pop.update_burst(state, buffer, chunk(2), BURST)
+            drain(m["loss_q"])  # compile + warmup
+            n_bursts = 40 if n_members <= 32 else 20
+            chunks = [chunk(10 + i) for i in range(n_bursts)]
+            for c in chunks:
+                drain(jax.tree_util.tree_reduce(
+                    lambda a, leaf: a + jnp.sum(leaf), c, jnp.float32(0.0)
+                ))
+            t0 = time.perf_counter()
+            for c in chunks:
+                state, buffer, m = pop.update_burst(state, buffer, c, BURST)
+            drain(m["loss_q"])
+            dt = time.perf_counter() - t0
+            agg = n_bursts * BURST * n_members / dt
+            entry["grad_steps_per_sec_aggregate"] = round(agg, 1)
+            if n_members == 1:
+                base_sps = agg
+            if base_sps is not None:
+                # Only ever relative to a MEASURED N=1 point; if that
+                # point failed, publishing "scaling_vs_1" against some
+                # other N would corrupt the scaling claim.
+                entry["scaling_vs_1"] = round(agg / base_sps, 2)
+        except Exception as e:  # noqa: BLE001 — per-point best effort
+            entry["error"] = repr(e)[:200]
+        out.append(entry)
+    return out
+
+
 def bench_unroll(budget_s=300.0):
     """Burst-scan unroll tuning at the headline config: the per-step
     kernels are launch-bound at batch 64 x [256,256], so unrolling the
@@ -1111,6 +1198,7 @@ _STAGES = {
     "sweep": lambda: {"sweep": bench_sweep()},
     "unroll": lambda: {"burst_unroll": bench_unroll()},
     "td3": lambda: {"td3": bench_td3()},
+    "population": lambda: {"population": bench_population()},
     "visual": lambda: {"visual": bench_visual()},
     "host_envs": lambda: {"host_envs": bench_host_envs()},
     "on_device": lambda: {"on_device": bench_on_device()},
@@ -1236,7 +1324,7 @@ def main():
             # attention runs two lengths with 180s internal budgets
             # each; its timeout covers both plus init + compiles.
             ("sweep", 900), ("unroll", 420), ("td3", 420),
-            ("on_device", 540), ("attention", 900),
+            ("population", 600), ("on_device", 540), ("attention", 900),
         ):
             res = run_stage_subprocess(
                 stage, timeout_s, diagnostics, platform=info.get("platform")

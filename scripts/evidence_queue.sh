@@ -7,17 +7,17 @@
 # the queue is running; the queue exits when the file is empty.
 # Start with:
 #   nohup bash scripts/evidence_queue.sh >> runs/evidence_queue.log 2>&1 &
+# APPEND PROTOCOL: writers must take the same lock as the pop, or an
+# append can land between the pop's read and its truncate-replace and
+# be lost:
+#   flock runs/evidence_queue.txt.lock bash -c \
+#     'printf "preset\n" >> runs/evidence_queue.txt'
 set -u
 cd "$(dirname "$0")/.."
 QUEUE=runs/evidence_queue.txt
 export JAX_PLATFORMS=cpu
 
 while true; do
-    next=$(head -n 1 "$QUEUE" 2>/dev/null || true)
-    if [ -z "${next:-}" ]; then
-        echo "[evidence_queue] queue empty; exiting at $(date -u +%FT%TZ)"
-        break
-    fi
     # Never contend with a chip capture: its torch-CPU baseline stage
     # is wall-clock-timed on this same core, and a concurrent evidence
     # run would inflate the vs_baseline ratio.
@@ -25,8 +25,25 @@ while true; do
         echo "[evidence_queue] chip capture in flight; waiting 60s"
         sleep 60
     done
-    # Consume the line before running so a crash doesn't loop forever.
-    tail -n +2 "$QUEUE" > "$QUEUE.tmp" && mv "$QUEUE.tmp" "$QUEUE"
+    # Atomic pop under flock: an append racing the read-truncate pair
+    # could land between `tail > tmp` and `mv` and be silently lost.
+    # The lock closes the race only for writers that follow the APPEND
+    # PROTOCOL above (take the same lock); the pop side alone cannot
+    # protect an unlocked `>>` from the truncate-replace.
+    next=$(
+        flock "$QUEUE.lock" bash -c '
+            next=$(head -n 1 "'"$QUEUE"'" 2>/dev/null || true)
+            if [ -n "$next" ]; then
+                tail -n +2 "'"$QUEUE"'" > "'"$QUEUE"'.tmp" \
+                    && mv "'"$QUEUE"'.tmp" "'"$QUEUE"'"
+            fi
+            printf "%s" "$next"
+        '
+    )
+    if [ -z "${next:-}" ]; then
+        echo "[evidence_queue] queue empty; exiting at $(date -u +%FT%TZ)"
+        break
+    fi
     echo "[evidence_queue] running $next at $(date -u +%FT%TZ)"
     if python scripts/evidence_run.py "$next"; then
         git add "runs/$next" 2>/dev/null

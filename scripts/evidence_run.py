@@ -150,6 +150,14 @@ PRESETS = {
         filters=(16, 32), kernel_sizes=(4, 3), strides=(2, 2),
         cnn_dense_size=128, cnn_features=1, normalize_pixels=False,
     ),
+    # Population training (VERDICT r4 #1): 4 independent SAC seeds on
+    # HalfCheetah advanced by ONE vmapped burst — the committed
+    # multi-seed artifact. metrics.jsonl carries reward_m0..m3 (4 real
+    # learning curves); summary.json records per-member eval stats.
+    "popcheetah": _preset(
+        "HalfCheetah-v5", epochs=20, steps_per_epoch=5000, max_ep_len=1000,
+        buffer_size=100_000, population=4,
+    ),
     # dm_control cheetah at 100k (PARITY.md "dm:cheetah:run"
     # comparison): the reference-default fixed alpha fails silently on
     # [0,1]-per-step rewards; the learned temperature and TD3 recover.
@@ -223,6 +231,9 @@ def run_preset(name: str) -> dict:
         "eval_episodes": spec["eval_episodes"],
         "wall_s": round(time.time() - t0, 1),
     }
+    if "per_member" in ev:
+        # Population runs: the N independent seed results.
+        summary["per_member"] = ev["per_member"]
     with open(tracker.run_dir / "summary.json", "w") as f:
         json.dump(summary, f, indent=2)
     tr.close()

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Round-5 long-run chain for the 1-core sandbox (sequential on purpose:
+# two CPU-bound trainings would halve each other's throughput).
+#
+#   1. the 120k-step fused DrQ pixel proof (VERDICT r4 #2) — all-or-
+#      nothing artifact, so it gets the core first and longest;
+#   2. the 4-seed population HalfCheetah evidence run (VERDICT r4 #1).
+#
+# Each stage commits its artifact as it lands, so a mid-chain death
+# costs only the unfinished stage.
+set -u
+cd "$(dirname "$0")/.."
+export TAC_BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu
+
+echo "[longruns] pixel proof starting at $(date -u +%FT%TZ)"
+python scripts/tpu_train_proof.py --task pixel --allow-cpu
+rc=$?
+echo "[longruns] pixel proof rc=$rc at $(date -u +%FT%TZ)"
+# rc 0 = solved, rc 2 = ran to completion but under the solved band —
+# both are complete, honest artifacts (the JSON records solved:
+# true/false). Anything else is a crash: a partial artifact must NOT
+# be committed as if it were the finished proof.
+if [ "$rc" -eq 0 ] || [ "$rc" -eq 2 ]; then
+    git add runs/train_proof/*.json 2>/dev/null
+    git commit -q -m "Pixel train proof: 120k-step fused DrQ run (CPU backend)" \
+        -- runs/train_proof 2>/dev/null && echo "[longruns] committed pixel proof"
+else
+    echo "[longruns] pixel proof CRASHED (rc=$rc); artifact left uncommitted"
+fi
+
+echo "[longruns] popcheetah starting at $(date -u +%FT%TZ)"
+if python scripts/evidence_run.py popcheetah; then
+    git add runs/popcheetah 2>/dev/null
+    git commit -q -m "Population evidence: 4-seed HalfCheetah, one vmapped burst" \
+        -- runs/popcheetah 2>/dev/null && echo "[longruns] committed popcheetah"
+else
+    echo "[longruns] popcheetah FAILED"
+fi
+echo "[longruns] chain done at $(date -u +%FT%TZ)"

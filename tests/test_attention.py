@@ -346,3 +346,29 @@ def test_flash_rejects_mixed_operand_dtypes():
     with pytest.raises(ValueError, match="share one dtype"):
         flash_attention(q, k.astype(jnp.bfloat16), v.astype(jnp.bfloat16),
                         False, 8, 8, True)
+
+
+def test_flash_surface_has_no_offset_masking():
+    """Pin the NaN-safety precondition of the guard-free flash kernels.
+
+    The in-kernel softmax dropped its isneginf guards on the invariant
+    that NO row can be fully masked: causal rows always see key 0, and
+    the public surface has no q/k position offsets or mask argument
+    that could break that (ops/attention.py _flash_kernel comments).
+    Whoever extends flash_attention with offset-style masking (e.g. a
+    ring-attention Pallas path — blockwise_attention has exactly those
+    params and keeps its guards) must re-add the guards and retire this
+    pin.
+    """
+    import inspect
+
+    from torch_actor_critic_tpu.ops import attention
+
+    forbidden = {"q_offset", "k_offset", "offset", "mask", "segment_ids"}
+    assert not (set(inspect.signature(attention.flash_attention).parameters)
+                & forbidden)
+    # The guarded blockwise path (ring attention's building block) DOES
+    # carry offsets — the asymmetry is the design, keep it visible.
+    assert {"q_offset", "k_offset"} <= set(
+        inspect.signature(attention.blockwise_attention).parameters
+    )

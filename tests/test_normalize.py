@@ -87,3 +87,34 @@ def test_state_dict_roundtrip_resets_base():
     assert fresh.count == norm.count
     # restored stats are the new sync base: no pending local delta
     assert fresh._local_delta()[2] == 0
+
+
+def test_features_normalizer_touches_only_features():
+    """Visual-obs normalization (VERDICT r4 #7): the `features` leaf is
+    Welford-whitened, the uint8 frame passes through bit-identical."""
+    import jax.numpy as jnp
+
+    from torch_actor_critic_tpu.core.types import MultiObservation
+    from torch_actor_critic_tpu.utils.normalize import FeaturesNormalizer
+
+    rng = np.random.default_rng(1)
+    norm = FeaturesNormalizer(DIM)
+    frames = rng.integers(0, 255, (8, 4, 4, 3), dtype=np.uint8)
+    feats = rng.normal(5.0, 3.0, (8, DIM))
+    out = norm.normalize(
+        MultiObservation(features=feats, frame=frames), update=True
+    )
+    assert out.frame.dtype == np.uint8
+    np.testing.assert_array_equal(out.frame, frames)
+    # After a big batch the running stats whiten the batch itself.
+    out2 = norm.normalize(
+        MultiObservation(features=feats, frame=frames), update=False
+    )
+    assert abs(float(np.mean(out2.features))) < 0.2
+    # state round-trip preserves the estimate (checkpoint path).
+    norm2 = FeaturesNormalizer(DIM)
+    norm2.load_state_dict(norm.state_dict())
+    out3 = norm2.normalize(
+        MultiObservation(features=feats, frame=frames), update=False
+    )
+    np.testing.assert_allclose(out3.features, out2.features)

@@ -158,3 +158,45 @@ def test_wall_runner_visual_training_real_env():
         assert frames.std() > 0
     finally:
         tr.close()
+
+
+def test_visual_features_normalization(monkeypatch):
+    """normalize_observations on a visual env Welford-whitens the
+    `features` leaf (VERDICT r4 #7) and the stats checkpoint through
+    the normalizer state_dict round-trip."""
+    import torch_actor_critic_tpu.envs.wrappers as wrappers_mod
+    import torch_actor_critic_tpu.sac.trainer as trainer_mod
+    from torch_actor_critic_tpu.utils.normalize import FeaturesNormalizer
+
+    monkeypatch.setattr(
+        wrappers_mod, "make_env", lambda name, seed=None: FakeVisualEnv(seed or 0)
+    )
+    monkeypatch.setattr(trainer_mod, "is_visual_env", lambda name: True)
+    cfg = SACConfig(
+        hidden_sizes=(16, 16),
+        batch_size=8,
+        epochs=1,
+        steps_per_epoch=30,
+        start_steps=10,
+        update_after=10,
+        update_every=10,
+        buffer_size=500,
+        max_ep_len=50,
+        filters=(8, 16),
+        kernel_sizes=(4, 3),
+        strides=(2, 1),
+        normalize_pixels=True,
+        normalize_observations=True,
+    )
+    tr = Trainer("FakeVisual-v0", cfg, mesh=make_mesh(dp=2))
+    assert isinstance(tr.normalizer, FeaturesNormalizer)
+    tr.train()
+    assert tr.normalizer.inner.count > 0
+    # The state a checkpoint would carry restores into a fresh instance.
+    import json
+
+    state = json.loads(json.dumps(tr.normalizer.state_dict()))
+    fresh = FeaturesNormalizer(len(state["features"]["mean"]))
+    fresh.load_state_dict(state)
+    assert fresh.inner.count == tr.normalizer.inner.count
+    tr.close()

@@ -307,6 +307,12 @@ def _pad_head_dim(
     contraction either way (the 128x128 systolic array bound — see
     SCALING.md's attention roofline), but the q/k/v/o tiles carry half
     the HBM traffic and VMEM footprint of the zero-padded layout.
+
+    ADOPTION GATE: ``lanes=64`` is validated in Pallas interpret mode
+    only; Mosaic may reject or de-optimize sub-128-lane tiles on real
+    hardware. 128 stays the default (and the only recommended value)
+    until an on-chip sweep artifact in ``runs/tpu/`` shows 64 both
+    lowering and winning.
     """
     d = arrays[0].shape[-1]
     if d % lanes == 0:

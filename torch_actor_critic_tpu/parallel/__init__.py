@@ -19,6 +19,9 @@ from torch_actor_critic_tpu.parallel.context import (  # noqa: F401
     make_ring_attention_fn,
     ring_attention,
 )
+from torch_actor_critic_tpu.parallel.population import (  # noqa: F401
+    PopulationLearner,
+)
 from torch_actor_critic_tpu.parallel.sharding import (  # noqa: F401
     shard_params,
     tp_specs,

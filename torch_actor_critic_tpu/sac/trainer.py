@@ -51,7 +51,11 @@ from torch_actor_critic_tpu.parallel.distributed import global_statistics, is_co
 from torch_actor_critic_tpu.sac.algorithm import SAC
 from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
 from torch_actor_critic_tpu.utils.config import SACConfig
-from torch_actor_critic_tpu.utils.normalize import IdentityNormalizer, WelfordNormalizer
+from torch_actor_critic_tpu.utils.normalize import (
+    FeaturesNormalizer,
+    IdentityNormalizer,
+    WelfordNormalizer,
+)
 from torch_actor_critic_tpu.utils.sync import drain
 from torch_actor_critic_tpu.utils.tracking import Tracker
 
@@ -280,7 +284,15 @@ class Trainer:
         # num_processes-fold redundant physics; single-host: all
         # slices). Seeds/stat streams use the GLOBAL slice index so a
         # run is invariant to how slices map onto hosts.
-        self.n_envs, self._env_offset = local_dp_info(self.mesh)
+        self.population = self.config.population
+        if self.population > 1:
+            # Population mode: one env per MEMBER (members shard over
+            # the dp axis inside the vmapped burst; the host loop still
+            # steps every member's env — single-process only, enforced
+            # by PopulationLearner).
+            self.n_envs, self._env_offset = self.population, 0
+        else:
+            self.n_envs, self._env_offset = local_dp_info(self.mesh)
         self.tracker = tracker
         self.checkpointer = checkpointer
 
@@ -310,14 +322,24 @@ class Trainer:
         )
         if self.config.normalize_observations and flat_obs:
             self.normalizer = WelfordNormalizer(self.pool.obs_spec.shape[0])
+        elif self.config.normalize_observations and isinstance(
+            self.pool.obs_spec, MultiObservation
+        ):
+            # Visual envs: Welford the proprioceptive `features` leaf
+            # (heterogeneous physical scales, e.g. the wall-runner's
+            # 168 dims); frames keep their own whitening path
+            # (normalize_pixels / DrQ) and uint8 replay layout.
+            self.normalizer = FeaturesNormalizer(
+                self.pool.obs_spec.features.shape[0]
+            )
         else:
-            # Welford tracks per-feature stats of flat vectors; visual
-            # and history observations run unnormalized.
+            # Welford tracks per-feature stats of flat vectors; history
+            # stacks run unnormalized (windows replay PAST observations
+            # — normalizing them with future statistics would leak).
             if self.config.normalize_observations:
                 logger.warning(
                     "normalize_observations=True ignored: obs spec %s is "
-                    "not a flat vector (visual/history stacks run "
-                    "unnormalized)",
+                    "a history stack, which runs unnormalized",
                     self.pool.obs_spec.shape,
                 )
             self.normalizer = IdentityNormalizer()
@@ -328,7 +350,14 @@ class Trainer:
         self.sac = make_learner(
             self.config, actor_def, critic_def, self.pool.act_dim
         )
-        self.dp = DataParallelSAC(self.sac, self.mesh)
+        if self.population > 1:
+            from torch_actor_critic_tpu.parallel.population import (
+                PopulationLearner,
+            )
+
+            self.dp = PopulationLearner(self.sac, self.population, self.mesh)
+        else:
+            self.dp = DataParallelSAC(self.sac, self.mesh)
 
         # Actor/learner split (Podracer-style): action selection runs on
         # the host CPU backend against a param mirror refreshed once per
@@ -351,12 +380,31 @@ class Trainer:
 
                 host_actor_def = host_actor_def.clone(attention_fn=xla_attention)
 
-            def _select(params, obs, key, deterministic=False):
-                action, _ = host_actor_def.apply(
-                    params, obs, key,
-                    deterministic=deterministic, with_logprob=False,
-                )
-                return action
+            if self.population > 1:
+                # Member i's policy acts on observation row i, with a
+                # per-member key fan-out (mirrors
+                # PopulationLearner.select_action on the host backend).
+                n_members = self.population
+
+                def _select(params, obs, key, deterministic=False):
+                    keys = jax.random.split(key, n_members)
+
+                    def one(p, o, k):
+                        action, _ = host_actor_def.apply(
+                            p, o, k,
+                            deterministic=deterministic, with_logprob=False,
+                        )
+                        return action
+
+                    return jax.vmap(one)(params, obs, keys)
+            else:
+
+                def _select(params, obs, key, deterministic=False):
+                    action, _ = host_actor_def.apply(
+                        params, obs, key,
+                        deterministic=deterministic, with_logprob=False,
+                    )
+                    return action
 
             self._host_select = jax.jit(
                 _select, static_argnames=("deterministic",), backend="cpu"
@@ -388,19 +436,33 @@ class Trainer:
         # global device 0 is unaddressable on non-coordinator hosts.
         init_key = jax.device_put(init_key, jax.local_devices()[0])
         self.state = self.dp.init_state(init_key, example_obs)
-        # Divide by the GLOBAL dp size (n_envs is the local slice
-        # count): total replay capacity is buffer_size regardless of how
-        # many hosts the slices are spread over.
-        per_dev_capacity = max(self.config.buffer_size // self.mesh.shape["dp"], 1)
-        warn_if_buffer_exceeds_hbm(
-            per_dev_capacity, self.pool.obs_spec, self.pool.act_dim,
-            sp=self.dp.effective_sp,
-            advice="reduce --buffer-size (or raise dp)",
-        )
-        self.buffer = init_sharded_buffer(
-            per_dev_capacity, self.pool.obs_spec, self.pool.act_dim, self.mesh,
-            sp=self.dp.effective_sp,
-        )
+        if self.population > 1:
+            # Each member is an independent run with its own FULL
+            # buffer_size ring — total HBM scales with the population.
+            warn_if_buffer_exceeds_hbm(
+                self.config.buffer_size * self.population,
+                self.pool.obs_spec, self.pool.act_dim,
+                advice="reduce --buffer-size or --population",
+            )
+            self.buffer = self.dp.init_buffer(
+                self.config.buffer_size, self.pool.obs_spec, self.pool.act_dim
+            )
+        else:
+            # Divide by the GLOBAL dp size (n_envs is the local slice
+            # count): total replay capacity is buffer_size regardless of
+            # how many hosts the slices are spread over.
+            per_dev_capacity = max(
+                self.config.buffer_size // self.mesh.shape["dp"], 1
+            )
+            warn_if_buffer_exceeds_hbm(
+                per_dev_capacity, self.pool.obs_spec, self.pool.act_dim,
+                sp=self.dp.effective_sp,
+                advice="reduce --buffer-size (or raise dp)",
+            )
+            self.buffer = init_sharded_buffer(
+                per_dev_capacity, self.pool.obs_spec, self.pool.act_dim,
+                self.mesh, sp=self.dp.effective_sp,
+            )
         self.start_epoch = 0
 
     # ------------------------------------------------------------ helpers
@@ -486,6 +548,9 @@ class Trainer:
         last_metrics: dict = {}
         episode_rewards: list = []
         episode_lengths: list = []
+        # Population mode keeps per-member return curves too — N seeds
+        # means N learning curves, not one average.
+        member_rewards: t.List[list] = [[] for _ in range(n)]
 
         try:
             import tqdm
@@ -549,6 +614,8 @@ class Trainer:
                     for i in map(int, np.flatnonzero(ended)):
                         episode_rewards.append(float(ep_ret[i]))
                         episode_lengths.append(int(ep_len[i]))
+                        if self.population > 1:
+                            member_rewards[i].append(float(ep_ret[i]))
                         _set_row(
                             next_obs,
                             i,
@@ -562,10 +629,15 @@ class Trainer:
                 # --- device window: push or push+update (ref :273-283) ---
                 window_full = (step + 1) % cfg.update_every == 0
                 if window_full:
-                    chunk = shard_chunk_from_local(
-                        self._build_chunk(staging), self.mesh,
-                        sp=self.dp.effective_sp,
-                    )
+                    local_chunk = self._build_chunk(staging)
+                    if self.population > 1:
+                        # Leading axis is the member axis; the learner
+                        # shards it over dp itself (no mesh resharding).
+                        chunk = self.dp.place_chunk(local_chunk)
+                    else:
+                        chunk = shard_chunk_from_local(
+                            local_chunk, self.mesh, sp=self.dp.effective_sp,
+                        )
                     staging = []
                     if step > cfg.update_after:
                         # (config validation guarantees host_actor here)
@@ -632,8 +704,18 @@ class Trainer:
                 "loss_q": float(jnp.mean(jnp.stack(losses_q))) if losses_q else 0.0,
                 "loss_pi": float(jnp.mean(jnp.stack(losses_pi))) if losses_pi else 0.0,
                 "env_steps_per_sec": env_steps_this_epoch / dt,
-                "grad_steps_per_sec": (len(losses_q) * cfg.update_every) / dt,
+                "grad_steps_per_sec": (
+                    len(losses_q) * cfg.update_every * max(self.population, 1)
+                ) / dt,
             }
+            if self.population > 1:
+                # Per-member epoch-mean returns: the N learning curves.
+                for i in range(n):
+                    if member_rewards[i]:
+                        last_metrics[f"reward_m{i}"] = float(
+                            np.mean(member_rewards[i])
+                        )
+                member_rewards = [[] for _ in range(n)]
             if is_coordinator() and self.tracker is not None:
                 self.tracker.log_metrics(last_metrics, e)
             # Orbax saves of sharded arrays are collective: EVERY process
@@ -741,12 +823,91 @@ class Trainer:
                 eval_key = jax.device_put(eval_key, self._host_device)
             self._act_key = eval_key
         try:
+            if self.population > 1:
+                return self._evaluate_population(
+                    episodes, deterministic, render, seed
+                )
             return self._evaluate_episodes(episodes, deterministic, render, seed)
         finally:
             # Restore the training exploration stream: a periodic seeded
             # eval must not make every post-eval epoch replay identical
             # exploration noise.
             self._act_key = saved_key
+
+    def _evaluate_population(
+        self, episodes: int, deterministic: bool, render: bool, seed: int | None
+    ) -> dict:
+        """Per-member evaluation: member ``i``'s policy rolls out
+        ``episodes`` episodes on its own env slot. Episode ``j`` resets
+        every member's env with ``seed + j`` — the SAME env realizations
+        across members, so per-member differences measure the policies,
+        not the reset draws. Returns the aggregate stats plus
+        ``per_member`` mean/std lists (the N seed results).
+
+        Shares :meth:`_evaluate_episodes`'s fixed-width rollout
+        mechanics (padding rows for finished slots, the
+        terminated/truncated/max_ep_len cut, reseed-on-reset) — a
+        behavior change in one loop almost certainly applies to the
+        other. The stochastic-eval caveat there applies here too: with
+        ``deterministic=False`` the batched noise stream makes seeded
+        results reproducible only at a fixed population size."""
+        n = self.n_envs
+        obs, rets, lens, ep_idx = [], [], [], []
+        member_returns: t.List[list] = [[] for _ in range(n)]
+        member_lengths: t.List[list] = [[] for _ in range(n)]
+        for slot in range(n):
+            ep_seed = None if seed is None else seed + 0
+            o = self._normalize(
+                self.pool.reset_at(slot, seed=ep_seed), update=False
+            )
+            obs.append(o)
+            rets.append(0.0)
+            lens.append(0)
+            ep_idx.append(0)
+        while any(idx < episodes for idx in ep_idx):
+            batched = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *obs)
+            actions = self._policy_actions(batched, deterministic=deterministic)
+            for slot in range(n):
+                if ep_idx[slot] >= episodes:
+                    continue  # finished member: padding row, action dropped
+                o, r, terminated, truncated = self.pool.step_at(
+                    slot, actions[slot]
+                )
+                obs[slot] = self._normalize(o, update=False)
+                rets[slot] += r
+                lens[slot] += 1
+                if render and self._render_ok:
+                    self.pool.render_at(slot)
+                if (
+                    terminated or truncated
+                    or lens[slot] >= self.config.max_ep_len
+                ):
+                    member_returns[slot].append(rets[slot])
+                    member_lengths[slot].append(lens[slot])
+                    ep_idx[slot] += 1
+                    if ep_idx[slot] < episodes:
+                        ep_seed = (
+                            None if seed is None else seed + ep_idx[slot]
+                        )
+                        obs[slot] = self._normalize(
+                            self.pool.reset_at(slot, seed=ep_seed),
+                            update=False,
+                        )
+                        rets[slot], lens[slot] = 0.0, 0
+        all_returns = [r for m in member_returns for r in m]
+        all_lengths = [l for m in member_lengths for l in m]
+        return {
+            "ep_ret_mean": float(np.mean(all_returns)),
+            "ep_ret_std": float(np.std(all_returns)),
+            "ep_len_mean": float(np.mean(all_lengths)),
+            "per_member": [
+                {
+                    "ep_ret_mean": float(np.mean(m)),
+                    "ep_ret_std": float(np.std(m)),
+                }
+                for m in member_returns
+            ],
+        }
 
     def _evaluate_episodes(
         self, episodes: int, deterministic: bool, render: bool, seed: int | None
@@ -762,6 +923,13 @@ class Trainer:
         single-env protocol while wall-clock drops ~n_envs-fold.
         The reference evaluates one env serially (ref
         ``run_agent.py:19-48``).
+
+        Caveat (stochastic evals): with ``deterministic=False`` the
+        acting noise is drawn from one batched stream shared by all
+        slots, so a seeded stochastic eval is reproducible for a FIXED
+        pool width but does not replay the old serial protocol and
+        changes with ``n_envs``. Deterministic evals (the reference
+        protocol and every committed artifact) are width-invariant.
         """
         n_slots = min(self.n_envs, episodes)
         next_ep = 0

@@ -35,6 +35,19 @@ CKPT_FORMAT = 3  # 3: VisualDoubleCritic ensemble unrolled (ensemble_i
 # 'ensemble' with a stacked leading axis) no longer restore
 
 
+def _has_unrolled_visual_ensemble(train_state: TrainState) -> bool:
+    """True when the critic tree is a format-3 unrolled visual ensemble
+    (``ensemble_i`` submodules, models/visual.py) — the ONLY family
+    whose layout changed between formats 2 and 3."""
+    flat = jax.tree_util.tree_flatten_with_path(train_state.critic_params)[0]
+    return any(
+        getattr(k, "key", None) is not None
+        and str(getattr(k, "key", "")).startswith("ensemble_")
+        for path, _ in flat
+        for k in path
+    )
+
+
 class Checkpointer:
     def __init__(
         self,
@@ -114,7 +127,13 @@ class Checkpointer:
         if meta_probe is None:
             meta_probe = self.peek_meta(epoch)
         found = int(meta_probe.get("ckpt_format", 1))
-        if found != CKPT_FORMAT:
+        if found != CKPT_FORMAT and not (
+            found == 2 and not _has_unrolled_visual_ensemble(abstract_train_state)
+        ):
+            # Format 3 only changed VisualDoubleCritic trees (ensemble
+            # unroll); format-2 checkpoints of every other family
+            # (flat MLP, TD3, sequence) restore unchanged — rejecting
+            # them would invalidate working checkpoints for no reason.
             raise ValueError(
                 f"checkpoint at {self.directory} epoch {epoch} has format "
                 f"{found}, this build reads format {CKPT_FORMAT}: the model "

@@ -97,6 +97,15 @@ class SACConfig:
     on_device: bool = False
     on_device_envs: int = 16
 
+    # Population training (parallel/population.py): N completely
+    # independent learners — own init, replay ring, optimizer and PRNG
+    # streams per member — advanced by ONE vmapped compiled burst, so
+    # the member matmuls batch onto the MXU together. The TPU-native
+    # answer to multi-seed runs (the reference needs N full processes,
+    # ref sac/mpi.py:10-34). Each member gets its own host env and its
+    # own `buffer_size`-slot ring; metrics carry per-member curves.
+    population: int = 1
+
     # Observation normalization (the reference ships a Welford
     # normalizer as dead code, ref sac/utils.py:27-65; here it's a
     # usable option).
@@ -190,6 +199,24 @@ class SACConfig:
         if self.burst_unroll < 0:
             raise ValueError(
                 f"burst_unroll must be >= 0 (0 = auto), got {self.burst_unroll}"
+            )
+        if self.population < 1:
+            raise ValueError(
+                f"population must be >= 1, got {self.population}"
+            )
+        if self.population > 1 and self.on_device:
+            raise ValueError(
+                "population > 1 is a host-Trainer mode; the fused "
+                "on-device loop batches envs per member differently — "
+                "run on_device with population=1"
+            )
+        if self.population > 1 and self.normalize_observations:
+            raise ValueError(
+                "population > 1 with normalize_observations would pool "
+                "one Welford estimate across members, silently coupling "
+                "the 'independent' seeds through their input scaling; "
+                "per-member normalizers are not wired yet — run the "
+                "population unnormalized"
             )
         if self.actor_param_lag and not self.host_actor:
             raise ValueError(

@@ -135,6 +135,42 @@ class WelfordNormalizer:
         self._base = (self.mean.copy(), self.m2.copy(), self.count)
 
 
+class FeaturesNormalizer:
+    """Welford normalization of the ``features`` leaf of a
+    :class:`~torch_actor_critic_tpu.core.types.MultiObservation`;
+    frames pass through untouched.
+
+    The visual envs that want this most are exactly the mixed-obs ones:
+    the wall-runner's 168 proprioceptive dims span heterogeneous scales
+    (ref ``environments/wall_runner.py:21``) while its pixels already
+    have a whitening path of their own (``normalize_pixels`` in the
+    model, DrQ augmentation in the update) — so statistics are tracked
+    for the feature vector only, and uint8 frames keep their replay
+    layout. Same state_dict/sync surface as :class:`WelfordNormalizer`,
+    so checkpointing and the multi-host epoch sync work unchanged.
+    """
+
+    def __init__(self, feature_dim: int, eps: float = 1e-8):
+        self.inner = WelfordNormalizer(feature_dim, eps)
+
+    def normalize(self, obs, update: bool = True):
+        from torch_actor_critic_tpu.core.types import MultiObservation
+
+        return MultiObservation(
+            features=self.inner.normalize(obs.features, update=update),
+            frame=obs.frame,
+        )
+
+    def sync_global(self) -> None:
+        self.inner.sync_global()
+
+    def state_dict(self) -> dict:
+        return {"features": self.inner.state_dict()}
+
+    def load_state_dict(self, d: t.Mapping) -> None:
+        self.inner.load_state_dict(d["features"])
+
+
 class IdentityNormalizer:
     """Pass-through (ref ``Identity``, ``sac/utils.py:68-79``)."""
 
