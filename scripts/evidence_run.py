@@ -181,6 +181,19 @@ PRESETS = {
         epochs=1, steps_per_epoch=600, start_steps=300, update_after=300,
         update_every=50, batch_size=32, buffer_size=600,
     ),
+    # Long wall-runner run (VERDICT r4 #6): the parallel env pool on
+    # the real composer task for hours. 1000-step epochs keep
+    # metrics.jsonl fine-grained, so a wall-clock cutoff still leaves
+    # a committed trend (composer+visual-SAC runs ~3 env-steps/s on
+    # this 1-core image — 50k steps is a ~5h budget; the pool's
+    # speedup story lives in bench.py's host_envs crossover section,
+    # which a 1-core host cannot demonstrate live).
+    "wallrunner-long": _preset(
+        "DeepMindWallRunner-v0", eval_episodes=2,
+        epochs=50, steps_per_epoch=1000, start_steps=1000,
+        update_after=1000, update_every=50, batch_size=32,
+        buffer_size=50_000, parallel_envs=True, max_ep_len=1000,
+    ),
 }
 
 

@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Round-5 follow-on chain: waits for round5_longruns.sh (pixel proof +
+# popcheetah) to release the core, then runs the remaining evidence:
+#
+#   3. sim-to-sim cheetah transfer probe (surrogate-trained policy on
+#      real MuJoCo — measures the surrogate gap, VERDICT r4 #5);
+#   4. the long wall-runner pool run (VERDICT r4 #6) — LAST because it
+#      eats whatever wall-clock remains; its per-epoch metrics.jsonl
+#      survives a cutoff, and this chain commits it periodically.
+set -u
+cd "$(dirname "$0")/.."
+export TAC_BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu
+
+# Wait for chain 1's explicit completion marker, not pgrep: a poll
+# landing in the gap BETWEEN chain 1's jobs (or before it starts)
+# would otherwise start this chain early and halve both jobs'
+# throughput on the 1-core host.
+while ! grep -q "\[longruns\] chain done" runs/longruns.log 2>/dev/null; do
+    sleep 120
+done
+echo "[longruns2] chain 1 done; cheetah transfer probe at $(date -u +%FT%TZ)"
+python scripts/tpu_train_proof.py --task cheetah --allow-cpu
+rc=$?
+if [ "$rc" -eq 0 ] || [ "$rc" -eq 2 ]; then
+    git add runs/train_proof/*.json 2>/dev/null
+    git commit -q -m "Cheetah sim-to-sim transfer probe (surrogate -> real MuJoCo eval)" \
+        -- runs/train_proof 2>/dev/null && echo "[longruns2] committed cheetah probe"
+else
+    echo "[longruns2] cheetah probe CRASHED (rc=$rc); not committed"
+fi
+
+echo "[longruns2] wallrunner-long starting at $(date -u +%FT%TZ)"
+# Periodic committer: the run's value is the trend, which must survive
+# a wall-clock cutoff. Commits runs/wallrunner-long every 20 min while
+# the training runs.
+python scripts/evidence_run.py wallrunner-long &
+train_pid=$!
+(
+    while kill -0 "$train_pid" 2>/dev/null; do
+        sleep 1200
+        git add runs/wallrunner-long 2>/dev/null
+        git commit -q -m "wallrunner-long: periodic metrics snapshot" \
+            -- runs/wallrunner-long 2>/dev/null \
+            && echo "[longruns2] periodic wallrunner-long commit"
+    done
+) &
+if wait "$train_pid"; then
+    git add runs/wallrunner-long 2>/dev/null
+    git commit -q -m "Wall-runner long run: parallel pool, committed trend" \
+        -- runs/wallrunner-long 2>/dev/null \
+        && echo "[longruns2] committed wallrunner-long"
+else
+    echo "[longruns2] wallrunner-long FAILED (partial metrics may be committed)"
+fi
+echo "[longruns2] chain done at $(date -u +%FT%TZ)"

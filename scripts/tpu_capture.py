@@ -81,6 +81,7 @@ def main() -> int:
         ("sweep", 900),
         ("unroll", 420),
         ("td3", 420),
+        ("population", 600),  # round-5: N-seed vmapped burst scaling
         ("visual", 480),
         ("on_device", 540),
         ("attention", 1200),

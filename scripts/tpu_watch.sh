@@ -22,10 +22,21 @@ assert float((jnp.ones((8,8))@jnp.ones((8,8)))[0,0]) == 8.0
 " >/dev/null 2>&1; then
         stamp=$(date -u +%Y%m%dT%H%M%SZ)
         echo "[tpu_watch] probe $i: tunnel alive; capturing ($stamp)"
+        # Chip minutes are rare; CPU evidence jobs (the --allow-cpu
+        # proof chain, evidence_run presets) would contend with the
+        # capture's wall-clock-timed stages (torch baseline!) on this
+        # 1-core host. Freeze them for the capture, resume after.
+        pkill -STOP -f "allow-cpu|evidence_run.py" 2>/dev/null
+        # EXIT alone does not fire on fatal signals (tmux kill-session
+        # sends HUP; kill sends TERM) — a dead watch must never leave
+        # the multi-hour evidence jobs frozen.
+        trap 'pkill -CONT -f "allow-cpu|evidence_run.py" 2>/dev/null' \
+            EXIT INT TERM HUP
         # Outer guard > worst-case sum of the capture's internal stage
-        # timeouts (~3500s+baseline), so stages die by their OWN timeouts
-        # (structured diagnostics) rather than by this kill.
-        timeout 5400 python scripts/tpu_capture.py 2>&1 \
+        # timeouts (600+600+900+420+420+600+480+540+1200 = 5760s +
+        # baseline), so stages die by their OWN timeouts (structured
+        # diagnostics) rather than by this kill.
+        timeout 6600 python scripts/tpu_capture.py 2>&1 \
             | tee "runs/tpu/capture_${stamp}.log" | tail -3
         # First-compile of the smoke's five stages (Mosaic flash bwd,
         # sequence burst) takes >15 min on the tunneled chip; 900s lost
@@ -67,6 +78,7 @@ assert float((jnp.ones((8,8))@jnp.ones((8,8)))[0,0]) == 8.0
             git commit -q -m "Record chip evidence captured ${stamp}" -- runs/tpu \
                 && echo "[tpu_watch] committed evidence (${stamp})"
         fi
+        pkill -CONT -f "allow-cpu|evidence_run.py" 2>/dev/null
         echo "[tpu_watch] capture done; next refresh in ${REFRESH_SLEEP}s"
         sleep "$REFRESH_SLEEP"
     else

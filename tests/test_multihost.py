@@ -146,3 +146,72 @@ def test_two_process_distributed_dryrun(tmp_path):
         assert f"MULTIHOST_OK proc={pid}/2" in out, out
         assert "devices=2/4" in out, out
     assert "coordinator=True" in outs[0] and "coordinator=False" in outs[1]
+
+
+@pytest.mark.slow
+def test_elastic_resume_across_topologies(tmp_path):
+    """Elastic resume (VERDICT r4 #8): checkpoint from a 4-process x
+    2-device run restores onto (a) 2 processes x 4 devices — same
+    global dp, different host topology, Orbax re-reads each host's new
+    shards — and (b) a single process with dp=4 — different GLOBAL dp,
+    replay rings rebuilt by parallel/elastic.reshard_buffer. Both
+    resumed runs keep training (burst runs, step advances)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ckpt = str(tmp_path / "elastic_ckpt")
+
+    def env_for(devices_per_proc):
+        env = dict(os.environ)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": (
+                    f"--xla_force_host_platform_device_count={devices_per_proc}"
+                ),
+                "PYTHONPATH": repo_root
+                + (
+                    os.pathsep + env["PYTHONPATH"]
+                    if os.environ.get("PYTHONPATH")
+                    else ""
+                ),
+                "PALLAS_AXON_POOL_IPS": "",
+            }
+        )
+        return env
+
+    def launch(n_procs, devices_per_proc, phase, extra=()):
+        return subprocess.run(
+            [
+                sys.executable, "-m",
+                "torch_actor_critic_tpu.parallel.launch",
+                "--processes", str(n_procs), "--",
+                sys.executable, "-m",
+                "torch_actor_critic_tpu.parallel.selftest",
+                "--coordinator", "{coordinator}",
+                "--processes", "{num_processes}",
+                "--process-id", "{process_id}",
+                "--ckpt-dir", ckpt,
+                "--phase", phase, *extra,
+            ],
+            env=env_for(devices_per_proc),
+            capture_output=True, text=True, timeout=900, cwd=repo_root,
+        )
+
+    # Phase 1: 4 hosts x 2 devices (global dp=8) trains and saves.
+    proc = launch(4, 2, "save")
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    for pid in range(4):
+        assert f"ELASTIC_SAVE_OK proc={pid}/4 dp=8" in out, out
+
+    # Phase 2: 2 hosts x 4 devices (same dp=8) resumes and trains on.
+    proc = launch(2, 4, "resume")
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    for pid in range(2):
+        assert f"ELASTIC_RESUME_OK proc={pid}/2 dp=8 step=6" in out, out
+
+    # Phase 3: one host, dp=4 (global dp HALVED) — ring reshard path.
+    proc = launch(1, 4, "resume-reshard", extra=("--old-ndev", "8"))
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    assert "ELASTIC_RESHARD_OK dp=8->4 transitions=256 step=6" in out, out

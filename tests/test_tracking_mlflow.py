@@ -1,0 +1,58 @@
+"""MLflow mirroring smoke test (VERDICT r4 #9).
+
+mlflow is not installed in this image, so the mirror is exercised
+against a faithful stub exposing the exact four entry points the
+Tracker calls (``set_experiment``, ``start_run``, ``log_params``,
+``log_metrics`` — the reference's usage surface, ref
+``main.py:132-138``). The point is pinning the Tracker side of the
+contract: every param/metric logged to the file tracker reaches the
+mirror with the same keys, values, and step.
+"""
+
+import sys
+import types
+
+from torch_actor_critic_tpu.utils.tracking import Tracker
+
+
+def _fake_mlflow():
+    calls = {"experiments": [], "runs": [], "params": [], "metrics": []}
+    mod = types.ModuleType("mlflow")
+    mod.set_experiment = lambda name: calls["experiments"].append(name)
+    mod.start_run = lambda run_name=None: calls["runs"].append(run_name)
+    mod.log_params = lambda p: calls["params"].append(dict(p))
+    mod.log_metrics = lambda m, step: calls["metrics"].append((dict(m), step))
+    return mod, calls
+
+
+def test_tracker_mirrors_params_and_metrics(tmp_path, monkeypatch):
+    mod, calls = _fake_mlflow()
+    monkeypatch.setitem(sys.modules, "mlflow", mod)
+    tr = Tracker(experiment="exp", root=tmp_path, mirror_mlflow=True)
+    assert calls["experiments"] == ["exp"]
+    assert calls["runs"] == [tr.run_id]
+
+    tr.log_params({"lr": 3e-4, "batch_size": 64})
+    tr.log_metrics({"loss_q": 1.5, "reward": -120.0}, step=3)
+    tr.log_metrics({"loss_q": 1.0}, step=4)
+
+    # The file tracker and the mirror saw the SAME stream.
+    assert calls["params"] == [{"lr": 3e-4, "batch_size": 64}]
+    assert calls["metrics"] == [
+        ({"loss_q": 1.5, "reward": -120.0}, 3),
+        ({"loss_q": 1.0}, 4),
+    ]
+    rows = tr.metrics()
+    assert rows[0]["loss_q"] == 1.5 and rows[0]["step"] == 3
+    assert tr.params() == {"lr": 3e-4, "batch_size": 64}
+
+
+def test_tracker_survives_missing_mlflow(tmp_path, monkeypatch):
+    """mirror_mlflow=True must degrade to file-only when mlflow is
+    absent (this image) — same run, no crash, no mirror."""
+    monkeypatch.setitem(sys.modules, "mlflow", None)  # import -> ImportError
+    tr = Tracker(experiment="exp", root=tmp_path, mirror_mlflow=True)
+    assert tr._mlflow is None
+    tr.log_params({"lr": 1.0})
+    tr.log_metrics({"x": 2.0}, step=0)
+    assert tr.metrics()[0]["x"] == 2.0

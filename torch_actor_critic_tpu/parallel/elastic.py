@@ -1,0 +1,130 @@
+"""Elastic resume: move a checkpoint between device/process topologies.
+
+Two capabilities the reference cannot express at all (its per-rank
+buffers die with their MPI ranks and resume restarts with an EMPTY
+buffer, ref ``main.py:28-51``, ``sac/mpi.py:24-34``):
+
+- **Process-elastic restore** needs no code here: Orbax restores into
+  an abstract pytree carrying the NEW mesh's shardings, so a buffer
+  saved by 4 processes x 2 devices restores onto 2 processes x 4
+  devices (same global dp) with each host reading exactly its newly
+  addressable shards (exercised by ``parallel/selftest.py`` phases).
+- **Device-elastic restore** — the global dp size itself changes — DOES
+  need resharding: replay shards are ring buffers whose leading device
+  axis must be re-split. :func:`reshard_buffer` does it losslessly:
+  each old shard is linearized oldest-to-newest (unwinding its ring
+  pointer), the streams are interleaved round-robin across the new
+  shards (preserving the per-slice temporal balance the trainer's
+  one-env-per-slice pairing creates), and fresh rings are rebuilt.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torch_actor_critic_tpu.core.types import BufferState
+
+
+def reshard_buffer(
+    buffer: BufferState,
+    new_n_dev: int,
+    capacity_per_device: int | None = None,
+    mesh=None,
+) -> BufferState:
+    """Redistribute an ``n_old``-sharded replay buffer over
+    ``new_n_dev`` shards (host-side; runs once at elastic resume).
+
+    ``capacity_per_device`` defaults to conserving total capacity
+    (``n_old * cap_old // new_n_dev``). If the valid transitions exceed
+    the new total capacity, the OLDEST are dropped — exactly what the
+    ring would have done to them next.  With ``mesh`` given, the result
+    is placed ``P('dp')``-sharded; otherwise it stays host-side (the
+    caller's ``device_put`` / ``init``-style placement applies).
+    """
+    data = jax.tree_util.tree_map(np.asarray, buffer.data)
+    ptr = np.asarray(buffer.ptr)
+    size = np.asarray(buffer.size)
+    n_old = int(size.shape[0])
+    cap_old = int(jax.tree_util.tree_leaves(data)[0].shape[1])
+    if capacity_per_device is None:
+        # Ceil, not floor: floor would SHRINK total capacity on
+        # non-divisible geometries and silently drop valid transitions
+        # the caller never asked to lose.
+        capacity_per_device = max(-(-n_old * cap_old // new_n_dev), 1)
+
+    # Linearize every shard oldest -> newest (ring order: the oldest
+    # valid row sits at ptr - size mod cap).
+    streams = []
+    for i in range(n_old):
+        s, p = int(size[i]), int(ptr[i])
+        idx = (p - s + np.arange(s)) % cap_old
+        streams.append(jax.tree_util.tree_map(lambda x: x[i][idx], data))
+
+    def concat(*leaves):
+        return np.concatenate(leaves, axis=0)
+
+    merged = jax.tree_util.tree_map(concat, *streams) if streams else data
+    total = int(sum(int(s) for s in size))
+
+    new_total_cap = new_n_dev * capacity_per_device
+
+    # Round-robin interleave across new shards. Order rows by their
+    # global age first (round-robin across OLD shards preserves each
+    # stream's internal order and the cross-stream balance).
+    order = []
+    sizes = [int(s) for s in size]
+    for step in range(max(sizes) if sizes else 0):
+        for i in range(n_old):
+            if step < sizes[i]:
+                order.append((i, step))
+    # (i, step) -> flat index into `merged` (streams concatenated).
+    offsets = np.cumsum([0] + sizes[:-1])
+    flat_idx = np.array(
+        [offsets[i] + step for i, step in order], dtype=np.int64
+    )
+    if total > new_total_cap:
+        # Keep the NEWEST rows — exactly what the ring would have
+        # overwritten next.
+        flat_idx = flat_idx[total - new_total_cap:]
+        total = new_total_cap
+
+    new_data = jax.tree_util.tree_map(
+        lambda x: np.zeros(
+            (new_n_dev, capacity_per_device) + x.shape[1:], x.dtype
+        ),
+        merged,
+    )
+    new_size = np.zeros((new_n_dev,), np.int32)
+    for j in range(new_n_dev):
+        rows = flat_idx[j::new_n_dev]
+        n_j = len(rows)
+        if n_j:
+            jax.tree_util.tree_map(
+                lambda dst, src: dst[j].__setitem__(
+                    np.arange(n_j), src[rows]
+                ),
+                new_data,
+                merged,
+            )
+        new_size[j] = n_j
+    new_ptr = (new_size % capacity_per_device).astype(np.int32)
+
+    out = BufferState(
+        data=new_data, ptr=new_ptr, size=new_size,
+    )
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from torch_actor_critic_tpu.parallel.mesh import global_device_put
+
+        put = lambda x: global_device_put(  # noqa: E731
+            x, NamedSharding(mesh, P("dp"))
+        )
+        out = jax.tree_util.tree_map(put, out)
+    else:
+        out = jax.tree_util.tree_map(jnp.asarray, out)
+    return out
