@@ -161,3 +161,18 @@ def test_population_eval_per_member(pop_trained):
     # Same protocol again -> same result (seeded, deterministic).
     ev2 = tr.evaluate(episodes=2, deterministic=True, seed=99)
     assert ev["ep_ret_mean"] == pytest.approx(ev2["ep_ret_mean"])
+
+
+def test_population_composes_with_utd():
+    """population x utd: N members each run round(update_every*utd)
+    updates per window inside the one vmapped burst."""
+    sac = _learner(utd=2.0)  # update_every=5 from _learner -> 10 updates
+    pop = PopulationLearner(sac, 2)
+    state = pop.init_state(jax.random.key(3), jnp.zeros((OBS,)))
+    buffer = pop.init_buffer(64, jax.ShapeDtypeStruct((OBS,), jnp.float32), ACT)
+    chunk = _chunk(jax.random.key(4), 2)
+    state, buffer, m = pop.update_burst(
+        state, buffer, chunk, sac.config.updates_per_window
+    )
+    assert int(np.asarray(state.step)[0]) == 10  # 5 steps x utd 2
+    assert m["loss_q"].shape == (2,)

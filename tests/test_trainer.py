@@ -410,3 +410,27 @@ def test_actor_param_lag_trains_and_keeps_mirror_warm():
 def test_actor_param_lag_requires_host_actor():
     with pytest.raises(ValueError, match="actor_param_lag"):
         SACConfig(actor_param_lag=True, host_actor=False)
+
+
+def test_utd_scales_updates_per_window():
+    """UTD (REDQ-style update-to-data ratio, extension): utd=2 doubles
+    the gradient steps each update window runs; the reference is pinned
+    at 1 (ref sac/algorithm.py:273-283)."""
+    cfg = SACConfig(
+        hidden_sizes=(16, 16), batch_size=16, epochs=1, steps_per_epoch=40,
+        start_steps=10, update_after=10, update_every=10, buffer_size=500,
+        max_ep_len=100, utd=2.0,
+    )
+    assert cfg.updates_per_window == 20
+    tr = Trainer("Pendulum-v1", cfg, mesh=make_mesh(dp=1))
+    tr.train()
+    # Windows end at steps 9/19/29/39; bursts run once step > 10:
+    # 3 bursts x 20 updates.
+    assert int(tr.state.step) == 60
+    tr.close()
+
+
+def test_utd_validation():
+    with pytest.raises(ValueError, match="no gradient steps"):
+        SACConfig(update_every=10, utd=0.01)
+    assert SACConfig(update_every=10, utd=0.5).updates_per_window == 5

@@ -198,8 +198,18 @@ class OnDeviceLoop:
                     "loss_pi": jnp.float32(0.0),
                 }
             else:
+                # UTD (config.utd) scales gradient steps per window —
+                # static at trace time, so the compiled epoch bakes in
+                # the exact scan length (default 1.0 = the reference's
+                # one-update-per-env-step cadence). The ONE cadence
+                # formula lives in SACConfig.updates_per_window;
+                # re-derive it for this loop's (possibly caller-
+                # overridden) window length.
+                num_updates = self.sac.config.replace(
+                    update_every=update_every
+                ).updates_per_window
                 ts, buf, m = self.sac.update_burst(
-                    ts, buf, chunk, update_every, axis_name=axis_name
+                    ts, buf, chunk, num_updates, axis_name=axis_name
                 )
             stats = {
                 "loss_q": m["loss_q"],
@@ -444,7 +454,12 @@ def train_on_device(
         metrics["env_steps_per_sec"] = (
             config.steps_per_epoch * loop.n_envs * loop.n_dp / dt
         )
-        metrics["grad_steps_per_sec"] = config.steps_per_epoch / dt
+        # utd scales updates per window (the epoch runs
+        # steps/update_every windows of updates_per_window steps each).
+        metrics["grad_steps_per_sec"] = (
+            (config.steps_per_epoch // config.update_every)
+            * config.updates_per_window / dt
+        )
         if tracker is not None and is_coordinator():
             tracker.log_metrics(metrics, e)
         # Final epoch always saves (same contract as the host Trainer):

@@ -654,7 +654,8 @@ class Trainer:
                                 self._fetch_params_single_transfer()
                             )
                         self.state, self.buffer, m = self.dp.update_burst(
-                            self.state, self.buffer, chunk, cfg.update_every
+                            self.state, self.buffer, chunk,
+                            cfg.updates_per_window,
                         )
                         if not cfg.actor_param_lag:
                             self._host_params = None  # mirror is stale
@@ -705,7 +706,8 @@ class Trainer:
                 "loss_pi": float(jnp.mean(jnp.stack(losses_pi))) if losses_pi else 0.0,
                 "env_steps_per_sec": env_steps_this_epoch / dt,
                 "grad_steps_per_sec": (
-                    len(losses_q) * cfg.update_every * max(self.population, 1)
+                    len(losses_q) * cfg.updates_per_window
+                    * max(self.population, 1)
                 ) / dt,
             }
             if self.population > 1:

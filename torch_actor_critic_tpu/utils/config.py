@@ -97,6 +97,15 @@ class SACConfig:
     on_device: bool = False
     on_device_envs: int = 16
 
+    # Update-to-data ratio (REDQ-style, extension): gradient steps per
+    # env step. The reference is pinned at 1 (update_every updates per
+    # update_every steps, ref sac/algorithm.py:273-283); utd > 1 runs
+    # round(update_every * utd) updates per window — the second lever
+    # (after population) that converts idle MXU into learning. utd < 1
+    # thins updates for env-bound setups. Must yield >= 1 update per
+    # window.
+    utd: float = 1.0
+
     # Population training (parallel/population.py): N completely
     # independent learners — own init, replay ring, optimizer and PRNG
     # streams per member — advanced by ONE vmapped compiled burst, so
@@ -200,6 +209,12 @@ class SACConfig:
             raise ValueError(
                 f"burst_unroll must be >= 0 (0 = auto), got {self.burst_unroll}"
             )
+        if self.utd <= 0 or round(self.update_every * self.utd) < 1:
+            raise ValueError(
+                f"utd={self.utd} with update_every={self.update_every} "
+                "yields no gradient steps per window; raise utd or "
+                "update_every"
+            )
         if self.population < 1:
             raise ValueError(
                 f"population must be >= 1, got {self.population}"
@@ -224,6 +239,13 @@ class SACConfig:
                 "device-actor path reads post-burst params directly, so "
                 "there is no mirror to run stale."
             )
+
+    @property
+    def updates_per_window(self) -> int:
+        """Gradient steps per ``update_every``-step window:
+        ``round(update_every * utd)``. At the default ``utd=1`` this is
+        exactly the reference's one-update-per-env-step cadence."""
+        return max(int(round(self.update_every * self.utd)), 1)
 
     @property
     def resolved_burst_unroll(self) -> int:
