@@ -1,0 +1,52 @@
+"""Complete the round-5 pixel proof artifact whose eval phase was lost.
+
+The 2026-08-01 06:08 UTC 120k-step fused DrQ run trained to completion
+(train block in ``train_proof_pixel_20260801T060825Z.json``) but its
+in-process eval never ran: the pre-fix exactly-one-new-run guard saw a
+second run directory (the concurrent cheetah smoke) and raised. The
+checkpoint is intact, so this script performs the IDENTICAL eval the
+proof would have run (run_agent, 10 deterministic episodes, seed 0,
+host PixelPendulumBalance-v0) and appends the same eval block.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+ARTIFACT = "runs/train_proof/train_proof_pixel_20260801T060825Z.json"
+RUN_ID = "6f628143c1694836"
+
+
+def main():
+    from torch_actor_critic_tpu.run_agent import main as eval_main
+
+    eval_metrics = eval_main([
+        "--run", RUN_ID,
+        "--runs-root", "runs/train_proof",
+        "--episodes", "10",
+        "--headless",
+        "--seed", "0",
+    ])
+    out = json.load(open(ARTIFACT))
+    out["eval"] = {
+        "episodes": 10,
+        "ep_ret_mean": round(float(eval_metrics["ep_ret_mean"]), 1),
+        "ep_ret_std": round(float(eval_metrics["ep_ret_std"]), 1),
+        "host_env": "PixelPendulumBalance-v0",
+        "solved_band_threshold": -400.0,
+        "solved": float(eval_metrics["ep_ret_mean"]) > -400.0,
+        "random_policy_baseline": -873.7,
+        "note": (
+            "eval re-run post-hoc by scripts/finish_pixel_proof.py: the "
+            "in-process eval died on the pre-fix one-new-run guard "
+            "(concurrent proof tasks now use per-task experiment dirs); "
+            "same protocol, same checkpoint, same seed"
+        ),
+    }
+    json.dump(out, open(ARTIFACT, "w"), indent=1, sort_keys=True)
+    print(json.dumps(out["eval"], indent=1))
+
+
+if __name__ == "__main__":
+    main()
