@@ -188,11 +188,17 @@ PRESETS = {
     # this 1-core image — 50k steps is a ~5h budget; the pool's
     # speedup story lives in bench.py's host_envs crossover section,
     # which a 1-core host cannot demonstrate live).
+    # learn_alpha: the wall-runner pays dm_control-scale [0,1]-per-step
+    # rewards, where the fixed alpha=0.2 entropy bonus swamps the
+    # signal (measured on dm:cheetah:run at 100k steps — eval 0.28
+    # fixed vs 309.1 learned, runs/dmcheetah-{fixed,learnalpha}); a
+    # TREND run must use the learned temperature.
     "wallrunner-long": _preset(
         "DeepMindWallRunner-v0", eval_episodes=2,
         epochs=50, steps_per_epoch=1000, start_steps=1000,
         update_after=1000, update_every=50, batch_size=32,
         buffer_size=50_000, parallel_envs=True, max_ep_len=1000,
+        learn_alpha=True,
     ),
 }
 

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Standalone wallrunner-long runner with 20-min periodic commits (the
+# round5_longruns2.sh tail, re-launched after the learn_alpha preset
+# fix; the trend must survive a wall-clock cutoff).
+set -u
+cd "$(dirname "$0")/.."
+export TAC_BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu
+
+echo "[wallrunner] starting at $(date -u +%FT%TZ)"
+python scripts/evidence_run.py wallrunner-long &
+train_pid=$!
+(
+    while kill -0 "$train_pid" 2>/dev/null; do
+        sleep 1200
+        git add runs/wallrunner-long 2>/dev/null
+        git commit -q -m "wallrunner-long: periodic metrics snapshot" \
+            -- runs/wallrunner-long 2>/dev/null \
+            && echo "[wallrunner] periodic commit"
+    done
+) &
+if wait "$train_pid"; then
+    git add runs/wallrunner-long 2>/dev/null
+    git commit -q -m "Wall-runner long run: parallel pool, committed trend" \
+        -- runs/wallrunner-long 2>/dev/null \
+        && echo "[wallrunner] committed final"
+else
+    echo "[wallrunner] FAILED or cut off (partial metrics committed above)"
+fi
+echo "[wallrunner] done at $(date -u +%FT%TZ)"

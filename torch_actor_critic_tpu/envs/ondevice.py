@@ -509,8 +509,11 @@ def get_on_device_env(name: str):
         logging.getLogger(__name__).warning(
             "on-device env for %r uses SURROGATE dynamics (%s): throughput "
             "comparisons are valid, return values are NOT comparable to "
-            "MuJoCo %s. Use the host-loop path (on_device=False) for "
-            "physics-parity returns.",
+            "MuJoCo %s. Measured transfer gap (runs/train_proof/"
+            "train_proof_cheetah_20260801T130042Z.json): a policy at "
+            "surrogate train reward ~9800 scores -501 on real MuJoCo — "
+            "below the random policy. Use the host-loop path "
+            "(on_device=False) for physics-parity returns.",
             name,
             env.__name__,
             name,
