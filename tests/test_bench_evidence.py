@@ -158,3 +158,33 @@ def test_capture_stage_names_exist_in_bench_registry():
     assert named, "no stages parsed from tpu_capture.py"
     unknown = named - set(bench._STAGES)
     assert not unknown, f"capture references unknown bench stages: {unknown}"
+
+
+def test_mopup_stage_registry_matches_bench():
+    """scripts/tpu_mopup.py retries stages by name against a (key,
+    timeout) table; both the stage names and the artifact keys they
+    wait for must track bench's registry, or a rename would silently
+    turn the mop-up into a no-op on the renamed stage."""
+    import importlib.util
+    import pathlib
+
+    path = pathlib.Path(
+        __file__, "..", "..", "scripts", "tpu_mopup.py"
+    ).resolve()
+    spec = importlib.util.spec_from_file_location("tpu_mopup", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    unknown = set(mod.STAGES) - set(bench._STAGES)
+    assert not unknown, f"mopup references unknown bench stages: {unknown}"
+    # The artifact key each stage is judged "missing" by must be a key
+    # that stage actually emits (spot-pinned: these names are part of
+    # the artifact schema consumed by load_last_known_tpu merging).
+    expected_keys = {
+        "td3": "td3", "population": "population", "visual": "visual",
+        "on_device": "on_device", "sweep": "sweep",
+        "unroll": "burst_unroll", "attention": "attention",
+    }
+    for stage, (key, timeout_s) in mod.STAGES.items():
+        assert key == expected_keys[stage], (stage, key)
+        assert timeout_s >= 1800, f"{stage}: slow-tunnel timeout too small"
