@@ -25,14 +25,17 @@ import bench  # noqa: E402
 
 # Stage -> (result key in the artifact, generous timeout). Timeouts are
 # sized for minutes-per-compile tunnel latency, not the happy path.
+# Order = bank-the-most-value-first for short tunnel windows: the
+# VERDICT-named rows (td3, population, visual, attention) and the cheap
+# stages before the 10-point MFU sweep.
 STAGES = {
-    "sweep": ("sweep", 2700),
-    "unroll": ("burst_unroll", 1800),
     "td3": ("td3", 1800),
     "population": ("population", 2400),
+    "unroll": ("burst_unroll", 1800),
     "visual": ("visual", 2400),
     "on_device": ("on_device", 2400),
     "attention": ("attention", 3600),
+    "sweep": ("sweep", 2700),
 }
 
 

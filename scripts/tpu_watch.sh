@@ -4,15 +4,25 @@
 # Evidence lands in the repo, never /tmp — a tunnel that dies later
 # cannot erase it (VERDICT r2 item 1).
 #
+# Round-5 revision: the 2026-08-02 window showed a new failure mode —
+# the tunnel answers but compiles each XLA program in MINUTES, so the
+# original fixed per-stage timeouts killed most stages mid-compile.
+# The loop now (a) probes faster (windows are short; every probe-cycle
+# minute is capture budget), (b) fills ONE round-accumulating artifact
+# via scripts/tpu_mopup.py with slow-tunnel timeouts instead of
+# restarting a fresh capture per window — the persistent compile cache
+# (.jax_cache) makes retries progressive, and (c) commits after every
+# completed stage via the mop-up's incremental flush + the commit step
+# below.
+#
 # Run it in the background for a whole working session:
 #   tmux new-session -d -s tpuwatch 'bash scripts/tpu_watch.sh'
-# After a successful capture it keeps polling at a slow cadence to
-# refresh the evidence opportunistically.
 set -u
 cd "$(dirname "$0")/.." || exit 1
 mkdir -p runs/tpu
-PROBE_SLEEP=240       # between probes while the tunnel is down
-REFRESH_SLEEP=3600    # between captures once we have evidence
+PROBE_SLEEP=120       # between probes while the tunnel is down
+REFRESH_SLEEP=1800    # between cycles once the artifact is complete
+ARTIFACT="runs/tpu/bench_20260802T154654Z.json"  # round-5 accumulator
 i=0
 while :; do
     i=$((i + 1))
@@ -32,29 +42,21 @@ assert float((jnp.ones((8,8))@jnp.ones((8,8)))[0,0]) == 8.0
         # the multi-hour evidence jobs frozen.
         trap 'pkill -CONT -f "allow-cpu|evidence_run.py" 2>/dev/null' \
             EXIT INT TERM HUP
-        # Outer guard > worst-case sum of the capture's internal stage
-        # timeouts (600+600+900+420+420+600+480+540+1200 = 5760s +
-        # baseline), so stages die by their OWN timeouts (structured
-        # diagnostics) rather than by this kill.
-        timeout 6600 python scripts/tpu_capture.py 2>&1 \
-            | tee "runs/tpu/capture_${stamp}.log" | tail -3
-        # First-compile of the smoke's five stages (Mosaic flash bwd,
-        # sequence burst) takes >15 min on the tunneled chip; 900s lost
-        # the later stages to the outer kill.
-        timeout 2400 python scripts/tpu_smoke.py >"runs/tpu/smoke_${stamp}.log" 2>&1
-        tail -2 "runs/tpu/smoke_${stamp}.log"
-        # One-shot convergence proof (train on chip, eval on host env);
-        # refresh manually if ever needed — a SOLVED proof does not
-        # improve with repetition. Only "solved": true satisfies the
-        # guard: a timeout-killed partial artifact AND a complete-but-
-        # unsolved run (bad seed/undertrained) both get retried.
-        # (train_proof_[0-9]* excludes the pixel artifacts below —
-        # each proof family has its own one-shot guard.)
-        if ! grep -ls '"solved": true' runs/tpu/train_proof_[0-9]*.json >/dev/null 2>&1; then
-            timeout 3600 python scripts/tpu_train_proof.py \
-                >"runs/tpu/train_proof_${stamp}.log" 2>&1
-            tail -2 "runs/tpu/train_proof_${stamp}.log"
+        # Fill the round artifact's missing stages, cheapest-first so a
+        # short window banks the most sections (mop-up flushes + we
+        # commit after the whole pass; its per-stage timeouts assume
+        # minutes-per-compile). The artifact keeps its original
+        # captured_utc; each mop-up stage that lands IS round-5-fresh.
+        if [ -f "$ARTIFACT" ]; then
+            timeout 14400 python scripts/tpu_mopup.py "$ARTIFACT" \
+                2>&1 | tee -a "runs/tpu/mopup_${stamp}.log" | tail -3
+        else
+            timeout 6600 python scripts/tpu_capture.py 2>&1 \
+                | tee "runs/tpu/capture_${stamp}.log" | tail -3
         fi
+        git add runs/tpu >/dev/null 2>&1
+        git diff --cached --quiet -- runs/tpu || \
+            git commit -q -m "Chip evidence: bench stages (${stamp})" -- runs/tpu
         # Pixel proof: visual SAC (DrQ recipe) trained through the
         # fused on-chip-rendered loop, evaluated on the host env —
         # the pixel-learning demonstration the CPU budget cannot
@@ -66,9 +68,29 @@ assert float((jnp.ones((8,8))@jnp.ones((8,8)))[0,0]) == 8.0
         pixel_tries=$(ls runs/tpu/train_proof_pixel_*.json 2>/dev/null | wc -l)
         if [ "$pixel_tries" -lt 3 ] \
            && ! grep -ls '"solved": true' runs/tpu/train_proof_pixel_*.json >/dev/null 2>&1; then
-            timeout 3600 python scripts/tpu_train_proof.py --task pixel \
+            timeout 7200 python scripts/tpu_train_proof.py --task pixel \
                 >"runs/tpu/train_proof_pixel_${stamp}.log" 2>&1
             tail -2 "runs/tpu/train_proof_pixel_${stamp}.log"
+        fi
+        # First-compile of the smoke's five stages (Mosaic flash bwd,
+        # sequence burst) takes >15 min on the tunneled chip; slow
+        # windows take longer still.
+        if [ ! -f runs/tpu/smoke_r5_ok ]; then
+            if timeout 3600 python scripts/tpu_smoke.py \
+                    >"runs/tpu/smoke_${stamp}.log" 2>&1; then
+                touch runs/tpu/smoke_r5_ok
+            fi
+            tail -2 "runs/tpu/smoke_${stamp}.log"
+        fi
+        # One-shot convergence proof (train on chip, eval on host env);
+        # a SOLVED proof does not improve with repetition. Only
+        # "solved": true satisfies the guard.
+        # (train_proof_[0-9]* excludes the pixel artifacts above —
+        # each proof family has its own one-shot guard.)
+        if ! grep -ls '"solved": true' runs/tpu/train_proof_[0-9]*.json >/dev/null 2>&1; then
+            timeout 3600 python scripts/tpu_train_proof.py \
+                >"runs/tpu/train_proof_${stamp}.log" 2>&1
+            tail -2 "runs/tpu/train_proof_${stamp}.log"
         fi
         # Artifacts must survive even if nobody is around to commit
         # them: commit runs/tpu/ (and only it) right away. The rolling
