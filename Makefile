@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost
+.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -36,6 +36,15 @@ parity:
 # 2-process distributed dryrun (initialize_multihost, collective saves).
 multihost:
 	python -m pytest tests/test_multihost.py -q
+
+# Serve a tracked run over HTTP (RUN=<id>; see docs/SERVING.md).
+serve:
+	python serve.py --run $(RUN)
+
+# CI smoke: checkpoint -> serve.py CLI on a random port -> /act +
+# /healthz round-trip; exits nonzero on failure.
+serve-smoke:
+	JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1105,6 +1105,92 @@ def bench_host_envs(n_envs=4, budget_s=600.0):
     return out
 
 
+def bench_serving(budget_s=180.0, n_threads=16, requests_per_thread=150):
+    """Policy-serving throughput through the real serve/ stack: an
+    in-process :class:`PolicyClient` fan-out of concurrent single-obs
+    requests through the micro-batcher and the bucketed jitted forward
+    (exactly the path the HTTP frontend parks on). Reports
+    requests/sec, latency percentiles and mean batch occupancy — the
+    numbers docs/SERVING.md's tuning section is about."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torch_actor_critic_tpu.models import Actor
+    from torch_actor_critic_tpu.serve import (
+        MicroBatcher,
+        ModelRegistry,
+        PolicyClient,
+    )
+
+    t_start = time.time()
+    actor = Actor(act_dim=ACT_DIM, hidden_sizes=HIDDEN)
+    params = actor.init(
+        jax.random.key(0), jnp.zeros((OBS_DIM,)), jax.random.key(1)
+    )
+    obs_spec = jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32)
+    registry = ModelRegistry()
+    max_batch = 64
+    registry.register(
+        "default", actor, obs_spec, params=params, max_batch=max_batch,
+    )  # warmup compiles every bucket before the clock starts
+    out = {
+        "obs_dim": OBS_DIM, "act_dim": ACT_DIM,
+        "hidden": list(HIDDEN), "max_batch": max_batch,
+        "n_client_threads": n_threads,
+        "backend": jax.default_backend(),
+    }
+    rng = np.random.default_rng(0)
+    all_obs = rng.standard_normal((n_threads, OBS_DIM)).astype(np.float32)
+    errors = []
+
+    with MicroBatcher(registry, max_batch=max_batch, max_wait_ms=2.0) as mb:
+        client = PolicyClient(registry, mb)
+
+        def worker(i):
+            try:
+                for _ in range(requests_per_thread):
+                    client.act(all_obs[i], deterministic=True)
+                    if time.time() - t_start > budget_s:
+                        return
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                errors.append(repr(e)[:200])
+
+        # a short rinse so the timed window starts steady-state
+        client.act(all_obs[0], deterministic=True)
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(n_threads)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=budget_s + 60)
+        elapsed = time.perf_counter() - t0
+        snap = mb.metrics.snapshot()
+
+    done = snap["responses_total"] - 1  # minus the rinse request
+    out.update({
+        "requests": done,
+        "requests_per_sec": round(done / elapsed, 1),
+        "p50_ms": snap.get("p50_ms"),
+        "p95_ms": snap.get("p95_ms"),
+        "p99_ms": snap.get("p99_ms"),
+        "mean_batch_occupancy": snap.get("mean_batch_occupancy"),
+        "mean_rows_per_batch": snap.get("mean_rows_per_batch"),
+        "batches_total": snap["batches_total"],
+    })
+    if errors:
+        out["errors"] = errors[:5]
+    log(f"serving: {out['requests_per_sec']} req/s, "
+        f"p50 {out['p50_ms']}ms p99 {out['p99_ms']}ms, "
+        f"occupancy {out['mean_batch_occupancy']}")
+    return out
+
+
 def bench_torch_cpu(n_steps=300):
     """Reference-style torch-CPU SAC update, timed per gradient step
     incl. uniform replay sampling — the measured stand-in for the
@@ -1200,6 +1286,7 @@ _STAGES = {
     "td3": lambda: {"td3": bench_td3()},
     "population": lambda: {"population": bench_population()},
     "visual": lambda: {"visual": bench_visual()},
+    "serving": lambda: {"serving": bench_serving()},
     "host_envs": lambda: {"host_envs": bench_host_envs()},
     "on_device": lambda: {"on_device": bench_on_device()},
     # Two sequence lengths: the O(block)-memory kernel's scaling story —
@@ -1351,6 +1438,23 @@ def main():
             diagnostics.append({"visual_stage_error": res.pop("error")})
         if res:
             out.update(res)
+
+    # 5a'. Serving fan-out (serve/ micro-batcher + bucketed jit): runs
+    # on whatever backend preflight chose — the batcher/queue overhead
+    # it measures is host-side, and on a real chip the forward rides
+    # the accelerator exactly as production serving would.
+    serving_platform = (
+        info.get("platform")
+        if info.get("platform") not in (None, "none")
+        else "cpu"
+    )
+    res = run_stage_subprocess(
+        "serving", 420, diagnostics, platform=serving_platform
+    )
+    if res and "error" in res:
+        diagnostics.append({"serving_stage_error": res.pop("error")})
+    if res:
+        out.update(res)
 
     # 5b. Host env-loop throughput (pool on/off) — host-side CPU work
     # regardless of backend, so the child is pinned to the CPU platform

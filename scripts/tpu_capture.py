@@ -83,6 +83,7 @@ def main() -> int:
         ("td3", 420),
         ("population", 600),  # round-5: N-seed vmapped burst scaling
         ("visual", 480),
+        ("serving", 420),  # serve/ micro-batched inference fan-out
         ("on_device", 540),
         ("attention", 1200),
     ):

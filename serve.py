@@ -1,0 +1,160 @@
+"""Policy-inference service CLI.
+
+Serves a trained run's policy over HTTP with micro-batched, bucketed
+TPU forwards and checkpoint hot-reload (torch_actor_critic_tpu/serve/;
+docs/SERVING.md).
+
+Two ways to point it at a model:
+
+    # a tracked training run (runs/<experiment>/<run_id>, as train.py
+    # writes and run_agent.py reads) — env/config are read from the run
+    python serve.py --run <id> [--experiment Default] [--runs-root runs]
+
+    # a bare Orbax checkpoint dir + explicit flat-obs geometry
+    python serve.py --ckpt-dir /path/ckpts --obs-dim 17 --act-dim 6 \\
+        --act-limit 1.0
+
+Serving knobs: --port (0 = ephemeral, printed at startup), --max-batch,
+--max-wait-ms (deadline before a partial batch flushes), --buckets
+(comma list overriding the power-of-two ladder), --poll-interval
+(checkpoint hot-reload cadence in seconds; 0 disables).
+
+Endpoints: POST /act, GET /healthz, GET /metrics, POST /reload.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+logging.basicConfig(level=logging.INFO)
+logger = logging.getLogger("serve")
+
+
+def parse_arguments(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("Batched policy-inference service.")
+    src = p.add_argument_group("model source")
+    src.add_argument("--run", type=str, default=None,
+                     help="Tracked run id to serve (reads env + config)")
+    src.add_argument("--experiment", default="Default")
+    src.add_argument("--runs-root", default="runs")
+    src.add_argument("--ckpt-dir", type=str, default=None,
+                     help="Bare Orbax checkpoint dir (needs --obs-dim/"
+                          "--act-dim for flat observations)")
+    src.add_argument("--obs-dim", type=int, default=None)
+    src.add_argument("--act-dim", type=int, default=None)
+    src.add_argument("--act-limit", type=float, default=1.0)
+    srv = p.add_argument_group("serving")
+    srv.add_argument("--host", default="127.0.0.1")
+    srv.add_argument("--port", type=int, default=8321)
+    srv.add_argument("--max-batch", type=int, default=64)
+    srv.add_argument("--max-wait-ms", type=float, default=2.0)
+    srv.add_argument("--buckets", type=str, default=None,
+                     help="Comma-separated bucket sizes (default: powers "
+                          "of two up to max-batch)")
+    srv.add_argument("--poll-interval", type=float, default=5.0,
+                     help="Checkpoint hot-reload poll seconds (0 = off)")
+    srv.add_argument("--seed", type=int, default=0,
+                     help="PRNG seed for sampled (non-deterministic) acting")
+    return p.parse_args(argv)
+
+
+def _resolve_model(args):
+    """(actor_def, obs_spec, ckpt_dir) from the CLI's model source."""
+    import jax
+    import jax.numpy as jnp
+
+    from torch_actor_critic_tpu.sac.trainer import build_models
+    from torch_actor_critic_tpu.utils.config import SACConfig
+
+    if args.run is not None:
+        from torch_actor_critic_tpu.envs.vec_env import make_env_pool
+        from torch_actor_critic_tpu.utils.tracking import Tracker
+
+        tracker = Tracker.load(
+            args.run, experiment=args.experiment, root=args.runs_root
+        )
+        params = tracker.params()
+        env_name = params.get("environment", "Humanoid-v5")
+        config = SACConfig.from_json(json.dumps(params.get("config", {})))
+        # One throwaway env just for its specs (obs/act geometry and
+        # limit); closed before serving starts.
+        pool = make_env_pool(env_name, 1, base_seed=0)
+        try:
+            obs_spec, act_dim, act_limit = (
+                pool.obs_spec, pool.act_dim, pool.act_limit
+            )
+        finally:
+            pool.close()
+        ckpt_dir = str(tracker.artifact_path("checkpoints"))
+        logger.info("serving run %s (%s)", args.run, env_name)
+    else:
+        if args.ckpt_dir is None:
+            raise SystemExit("pass --run or --ckpt-dir (see --help)")
+        if args.obs_dim is None or args.act_dim is None:
+            raise SystemExit("--ckpt-dir needs --obs-dim and --act-dim")
+        # Model geometry (hidden sizes, algorithm family, ...) comes
+        # from the checkpoint's own metadata — the trainer stores its
+        # config JSON alongside the arrays, so a bare dir serves with
+        # the architecture that produced it, not CLI defaults.
+        from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
+
+        probe = Checkpointer(args.ckpt_dir, save_buffer=False)
+        try:
+            meta = probe.peek_meta()
+        finally:
+            probe.close()
+        config = (
+            SACConfig.from_json(meta["config"])
+            if meta.get("config") else SACConfig()
+        )
+        obs_spec = jax.ShapeDtypeStruct((args.obs_dim,), jnp.float32)
+        act_dim, act_limit = args.act_dim, args.act_limit
+        ckpt_dir = args.ckpt_dir
+
+    class _Spec:
+        pass
+
+    _Spec.obs_spec = obs_spec
+    _Spec.act_dim = act_dim
+    _Spec.act_limit = act_limit
+    actor_def, _ = build_models(config, _Spec)
+    return actor_def, obs_spec, ckpt_dir
+
+
+def main(argv=None):
+    args = parse_arguments(argv)
+    from torch_actor_critic_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
+
+    from torch_actor_critic_tpu.serve import ModelRegistry, PolicyServer
+
+    actor_def, obs_spec, ckpt_dir = _resolve_model(args)
+    buckets = (
+        [int(b) for b in args.buckets.split(",")] if args.buckets else None
+    )
+
+    registry = ModelRegistry()
+    info = registry.register(
+        "default", actor_def, obs_spec,
+        ckpt_dir=ckpt_dir, max_batch=args.max_batch, buckets=buckets,
+    )
+    logger.info("model loaded: %s", info)
+    if args.poll_interval > 0:
+        registry.start_polling(args.poll_interval)
+
+    server = PolicyServer(
+        registry, host=args.host, port=args.port,
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        seed=args.seed,
+    )
+    print(json.dumps({
+        "serving": server.address, "slots": registry.slots(),
+    }), flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

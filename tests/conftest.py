@@ -14,6 +14,10 @@ import os
 import re
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# No GL stack in this container: mujoco's default EGL probe dies with an
+# opaque AttributeError at dm_control import. Physics needs no renderer;
+# tests that render go through paths that tolerate a disabled backend.
+os.environ.setdefault("MUJOCO_GL", "disabled")
 # The suite assumes exactly 8 virtual devices; strip any externally-set
 # device-count flag rather than half-honoring it and failing later.
 _flags = re.sub(

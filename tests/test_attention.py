@@ -15,6 +15,7 @@ import numpy as np
 import pytest
 
 from torch_actor_critic_tpu.models import SequenceActor, SequenceDoubleCritic
+from torch_actor_critic_tpu.parallel.compat import shard_map
 from torch_actor_critic_tpu.ops.attention import (
     attention,
     blockwise_attention,
@@ -212,7 +213,7 @@ def test_ring_attention_matches_full(causal):
         return ring_attention(q, k, v, "sp", 8, causal=causal)
 
     got = jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P(None, None, "sp"), P(None, None, "sp"), P(None, None, "sp")),
@@ -231,7 +232,7 @@ def test_ring_attention_differentiable():
         def body(q, k, v):
             return ring_attention(q, k, v, "sp", 8, causal=True)
 
-        out = jax.shard_map(
+        out = shard_map(
             body,
             mesh=mesh,
             in_specs=(P(None, None, "sp"),) * 3,

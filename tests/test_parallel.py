@@ -19,6 +19,7 @@ from torch_actor_critic_tpu.parallel import (
     make_mesh,
     shard_chunk,
 )
+from torch_actor_critic_tpu.parallel.compat import shard_map
 from torch_actor_critic_tpu.sac import SAC
 from torch_actor_critic_tpu.utils.config import SACConfig
 
@@ -161,7 +162,7 @@ def test_pmean_actually_averages_across_devices():
         return jax.lax.pmean(x, "dp")
 
     xs = jnp.arange(8.0)
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(xs)
+    out = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(xs)
     np.testing.assert_allclose(np.asarray(out), np.full(8, 3.5))
 
 
@@ -246,6 +247,10 @@ def test_tp_collective_count_in_hlo():
 def test_dp_tp_hybrid_matches_dp_only():
     """A (dp=4, tp=2) burst must compute the same update as (dp=4,
     tp=1): tensor parallelism changes layout, not math."""
+    if not hasattr(jax, "shard_map"):
+        # The legacy experimental shard_map miscompiles partially-auto
+        # meshes (see DataParallelSAC._build_burst's version gate).
+        pytest.skip("dp+tp hybrid burst needs native jax.shard_map (jax>=0.5)")
     cfg = SACConfig(hidden_sizes=(32, 32), batch_size=8)
 
     def run(tp):
@@ -451,7 +456,7 @@ def test_sp_loss_gradients_match_unsharded():
 
     seq_spec = P(None, "sp", None)
     g_sp = jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(

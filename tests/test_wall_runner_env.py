@@ -24,7 +24,16 @@ from torch_actor_critic_tpu.envs.wall_runner import (  # noqa: E402
 
 @pytest.fixture(scope="module")
 def environment():
-    return DeepMindWallRunner(seed=0)
+    try:
+        return DeepMindWallRunner(seed=0)
+    except RuntimeError as e:
+        if "rendering backend" in str(e) or "OpenGL" in str(e):
+            # The egocentric camera frame genuinely requires a GL stack
+            # (EGL/OSMesa/GLFW); hosts without one cannot run this env
+            # at all — skip rather than error (cf. conftest's
+            # MUJOCO_GL=disabled default for the physics-only tests).
+            pytest.skip(f"no OpenGL rendering backend: {e}")
+        raise
 
 
 def test_reset_contract(environment):

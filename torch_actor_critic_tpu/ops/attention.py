@@ -183,6 +183,12 @@ def blockwise_attention(
 _LANE = 128  # TPU lane width: last tile dim, and scratch column count
 
 
+def _compiler_params(pltpu):
+    """``pltpu.CompilerParams`` across the 0.4->0.5 rename (older jax
+    spells it ``TPUCompilerParams``; same constructor surface)."""
+    return getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+
 def _acc_dot(a: jax.Array, b: jax.Array, dims) -> jax.Array:
     """``dot_general`` with f32 accumulation on MXU-native operands.
 
@@ -443,7 +449,7 @@ def _flash_forward(
         ],
         # bh and q-block programs are independent; the k sweep carries
         # the online-softmax scratch and must stay sequential.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -612,7 +618,7 @@ def _flash_backward(
         in_specs=[qspec, kspec_dq, kspec_dq, qspec, rowspec, rowspec],
         out_specs=qspec,
         scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -642,7 +648,7 @@ def _flash_backward(
             pltpu.VMEM((block_k, dp), jnp.float32),
             pltpu.VMEM((block_k, dp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -1,0 +1,71 @@
+"""JAX version-compat shims for the manual-sharding API.
+
+The framework is written against the modern ``jax.shard_map`` surface
+(top-level export; ``axis_names=`` for partially-manual meshes;
+``check_vma=`` replication checking). Older jax releases (<= 0.4.x, e.g.
+the 0.4.37 this image ships) only have
+``jax.experimental.shard_map.shard_map`` with the inverse parameter
+convention: ``auto=`` names the axes that STAY automatic (GSPMD) rather
+than the axes that become manual, and the replication check is spelled
+``check_rep``.
+
+:func:`shard_map` here accepts the modern signature and translates:
+
+- present natively -> forwarded verbatim to ``jax.shard_map``;
+- legacy fallback -> ``axis_names`` complemented against
+  ``mesh.axis_names`` into ``auto``, ``check_vma`` renamed to
+  ``check_rep``.
+
+Every call site in the package (``parallel/dp.py``,
+``parallel/context.py``, ``sac/ondevice.py``) and the distributed tests
+route through this module, so a jax upgrade is a one-file audit.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(
+    f: t.Callable,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    axis_names: t.Optional[t.AbstractSet[str]] = None,
+    check_vma: t.Optional[bool] = None,
+):
+    """``jax.shard_map`` with a fallback onto the legacy experimental API.
+
+    ``axis_names``: the mesh axes the body sees as MANUAL collectives
+    axes; every other mesh axis stays a GSPMD auto axis (None = all
+    manual — both APIs' default). ``check_vma``: enable the
+    varying-manual-axes / replication check (None = API default).
+    """
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        kwargs: dict = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return native(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+
+    from jax.experimental.shard_map import shard_map as legacy
+
+    kwargs = {}
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kwargs["auto"] = auto
+    return legacy(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

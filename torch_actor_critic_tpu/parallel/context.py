@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from torch_actor_critic_tpu.parallel.compat import shard_map
 from torch_actor_critic_tpu.ops.attention import (
     finalize_online,
     online_block_update,
@@ -119,7 +120,7 @@ def _build_context_actor_step(
         )
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P(), P(None, "sp", None), P()),

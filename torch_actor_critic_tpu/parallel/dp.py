@@ -38,6 +38,8 @@ import typing as t
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from torch_actor_critic_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torch_actor_critic_tpu.buffer.replay import init_replay_buffer
@@ -286,6 +288,20 @@ class DataParallelSAC:
         # __init__ note).
         axes = ("dp", "sp") if self._sp_active else "dp"
         manual = {"dp", "sp"} if self._sp_active else {"dp"}
+        if not hasattr(jax, "shard_map") and any(
+            mesh.shape[a] > 1 for a in mesh.axis_names if a not in manual
+        ):
+            # jax <= 0.4.x (parallel/compat.py fallback): the
+            # experimental shard_map's partially-automatic mode
+            # miscompiles this burst (typed-PRNG-key output shardings,
+            # PartitionId lowering, and past those an XLA CHECK abort
+            # that takes the process down). Fail loudly up front.
+            raise NotImplementedError(
+                f"dp+tp hybrid parallelism needs jax.shard_map with "
+                f"partial-auto axis support (jax >= 0.5); this jax "
+                f"{jax.__version__} only runs fully-manual meshes — "
+                "set tp=1 or upgrade jax."
+            )
         buf_specs = _buffer_specs(buffer, sp)
         chunk_specs = _batch_specs(chunk, sp)
 
@@ -321,7 +337,7 @@ class DataParallelSAC:
             buffer = jax.tree_util.tree_map(lambda x: x[None], buffer)
             return state_out, buffer, metrics
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             burst_body,
             mesh=mesh,
             in_specs=(rep_spec, buf_specs, chunk_specs),
@@ -372,7 +388,7 @@ class DataParallelSAC:
                 return jax.tree_util.tree_map(lambda x: x[None], out)
 
             self._push = jax.jit(
-                jax.shard_map(
+                shard_map(
                     body,
                     mesh=self.mesh,
                     in_specs=(buf_specs, chunk_specs),

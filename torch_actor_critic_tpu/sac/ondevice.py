@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from torch_actor_critic_tpu.buffer.replay import init_replay_buffer, push
 from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
 from torch_actor_critic_tpu.envs.ondevice import EnvState
+from torch_actor_critic_tpu.parallel.compat import shard_map
 from torch_actor_critic_tpu.utils.sync import drain
 from torch_actor_critic_tpu.sac.algorithm import SAC
 
@@ -300,7 +301,7 @@ class OnDeviceLoop:
             return ts, buf, es, key_out, self._finalize_metrics(raw)
 
         dp_spec, rep = P(axis), P()
-        mapped = jax.shard_map(
+        mapped = shard_map(
             dp_body,
             mesh=mesh,
             in_specs=(rep, dp_spec, dp_spec, rep),

@@ -1,0 +1,38 @@
+"""TPU-native batched policy-inference service.
+
+The inference-side counterpart of the training stack: the trainer
+produces Orbax checkpoints, this package serves them. The design
+follows the Podracer observation (arXiv:2104.06272) that TPU inference
+throughput is won by batching many independent requests into ONE jitted
+forward pass, and the TorchBeast server-side dynamic-batching pattern
+(arXiv:1910.03552):
+
+- :mod:`~torch_actor_critic_tpu.serve.engine` — the jitted forward:
+  squashed-Gaussian mean or sampled action over a fixed set of
+  power-of-two **bucket** batch shapes, so XLA compiles a handful of
+  programs instead of one per request size.
+- :mod:`~torch_actor_critic_tpu.serve.batcher` — a thread-safe
+  micro-batching queue coalescing concurrent ``act`` calls up to
+  ``max_batch`` rows or a ``max_wait_ms`` deadline.
+- :mod:`~torch_actor_critic_tpu.serve.registry` — a multi-slot model
+  registry with checkpoint **hot-reload**: new epochs in the Orbax dir
+  swap in atomically under a generation counter; in-flight batches
+  finish on the params they captured, no request is ever dropped.
+- :mod:`~torch_actor_critic_tpu.serve.server` — a stdlib
+  ``ThreadingHTTPServer`` JSON frontend (``/act``, ``/healthz``,
+  ``/metrics``, ``/reload``) plus the in-process
+  :class:`~torch_actor_critic_tpu.serve.server.PolicyClient`.
+- :mod:`~torch_actor_critic_tpu.serve.metrics` — queue depth, batch
+  occupancy, request rate and latency percentiles.
+
+Entry point: ``python serve.py`` at the repo root (see docs/SERVING.md).
+"""
+
+from torch_actor_critic_tpu.serve.batcher import MicroBatcher  # noqa: F401
+from torch_actor_critic_tpu.serve.engine import PolicyEngine  # noqa: F401
+from torch_actor_critic_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from torch_actor_critic_tpu.serve.registry import ModelRegistry  # noqa: F401
+from torch_actor_critic_tpu.serve.server import (  # noqa: F401
+    PolicyClient,
+    PolicyServer,
+)

@@ -1,0 +1,294 @@
+"""Thread-safe micro-batching queue in front of the policy engine.
+
+The server-side dynamic-batching pattern (TorchBeast, arXiv:1910.03552;
+Podracer, arXiv:2104.06272): concurrent ``act(obs)`` calls land in one
+queue, and a single dispatcher thread coalesces them into engine
+forwards of up to ``max_batch`` rows — waiting at most ``max_wait_ms``
+past the oldest queued request before flushing whatever it has. One
+forward per coalesced group amortizes dispatch latency across every
+caller in it; the engine pads the group to its bucket shape
+(:mod:`~torch_actor_critic_tpu.serve.engine`), and responses are
+sliced back per request, so callers never observe the batching.
+
+Grouping rules:
+
+- only requests with the same ``(slot, deterministic)`` share a
+  forward (different slots are different params; the deterministic
+  flag is a static compile argument);
+- a request with more rows than ``max_batch`` is **split** into
+  max_batch-sized engine calls and its rows reassembled in order;
+- queue order is preserved within a group, and every request —
+  including ones drained during shutdown — gets its future resolved:
+  nothing is dropped.
+
+Each response carries the model **generation** it was computed under
+(:mod:`~torch_actor_critic_tpu.serve.registry`): the dispatcher
+captures ``(engine, params, generation)`` once per group, so a
+hot-reload swap mid-group simply means the group finishes on the old
+weights and the next group picks up the new ones.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import typing as t
+from concurrent.futures import Future
+
+import jax
+import numpy as np
+
+from torch_actor_critic_tpu.serve.metrics import ServeMetrics
+
+__all__ = ["MicroBatcher", "ActResult"]
+
+
+class ActResult(t.NamedTuple):
+    """One resolved ``act`` call: the action rows (leading axis matches
+    the request's) and the model generation that computed them."""
+
+    action: np.ndarray
+    generation: int
+
+
+class _Request:
+    __slots__ = ("obs", "rows", "slot", "deterministic", "future", "t_enq")
+
+    def __init__(self, obs, rows, slot, deterministic):
+        self.obs = obs
+        self.rows = rows
+        self.slot = slot
+        self.deterministic = deterministic
+        self.future: Future = Future()
+        self.t_enq = time.perf_counter()
+
+
+class MicroBatcher:
+    """Coalesces concurrent policy requests into bucketed forwards.
+
+    ``registry`` resolves slot names to ``(engine, params, generation)``
+    (:class:`~torch_actor_critic_tpu.serve.registry.ModelRegistry`).
+    ``max_batch`` bounds rows per engine call; ``max_wait_ms`` bounds
+    the queueing latency added to the OLDEST request in a group (a lone
+    request never waits longer than the deadline). ``seed`` keys the
+    sampled-action PRNG stream.
+    """
+
+    def __init__(
+        self,
+        registry,
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+        metrics: ServeMetrics | None = None,
+        seed: int = 0,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.registry = registry
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self._key = jax.random.key(seed)
+        self._queue: collections.deque[_Request] = collections.deque()
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name="micro-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- submit
+
+    def submit(
+        self,
+        obs: t.Any,
+        deterministic: bool = True,
+        slot: str = "default",
+    ) -> Future:
+        """Enqueue one request; returns a Future resolving to
+        :class:`ActResult`. ``obs`` is a single observation pytree or a
+        batch of them (leading axis); the response's leading axis
+        matches the request's."""
+        engine, _, _ = self.registry.acquire(slot)  # validates slot name
+        obs, rows, batched = self._ensure_batched(engine, obs)
+        req = _Request(obs, rows, slot, bool(deterministic))
+        outer: Future = Future()
+
+        def _copy(f: Future):
+            err = f.exception()
+            if err is not None:
+                outer.set_exception(err)
+                return
+            res: ActResult = f.result()
+            action = res.action if batched else res.action[0]
+            outer.set_result(ActResult(action, res.generation))
+
+        req.future.add_done_callback(_copy)
+        with self._nonempty:
+            # Checked under the lock: a request enqueued after close()
+            # flipped the flag would never be drained.
+            if not self._running:
+                raise RuntimeError("MicroBatcher is closed")
+            self._queue.append(req)
+            self.metrics.record_enqueue(len(self._queue))
+            self._nonempty.notify()
+        return outer
+
+    def act(
+        self,
+        obs: t.Any,
+        deterministic: bool = True,
+        slot: str = "default",
+        timeout: float | None = 30.0,
+    ) -> ActResult:
+        """Blocking :meth:`submit`."""
+        return self.submit(obs, deterministic, slot).result(timeout=timeout)
+
+    def _ensure_batched(self, engine, obs):
+        """(batched_obs, n_rows, was_batched) — unbatched observations
+        (leaf ndim == spec ndim) gain a leading axis of 1."""
+        spec_leaves = jax.tree_util.tree_leaves(engine.obs_spec)
+        obs_leaves = jax.tree_util.tree_leaves(obs)
+        if len(obs_leaves) != len(spec_leaves):
+            raise ValueError(
+                f"observation pytree has {len(obs_leaves)} leaves, "
+                f"slot expects {len(spec_leaves)}"
+            )
+        ndim = np.ndim(obs_leaves[0])
+        spec_ndim = len(spec_leaves[0].shape)
+        if ndim == spec_ndim:
+            obs = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[None], obs
+            )
+            return obs, 1, False
+        if ndim == spec_ndim + 1:
+            obs = jax.tree_util.tree_map(np.asarray, obs)
+            return obs, int(obs_leaves[0].shape[0]), True
+        raise ValueError(
+            f"observation rank {ndim} matches neither the spec rank "
+            f"{spec_ndim} (single) nor {spec_ndim + 1} (batched)"
+        )
+
+    # ----------------------------------------------------------- dispatch
+
+    def _dispatch_loop(self):
+        while True:
+            group = self._collect_group()
+            if group is None:
+                return
+            self._run_group(group)
+
+    def _collect_group(self) -> t.List[_Request] | None:
+        """Block for the next same-``(slot, deterministic)`` run of
+        queued requests: up to ``max_batch`` rows, or whatever is
+        queued when the oldest request's deadline expires. ``None``
+        means shutdown with an empty queue."""
+        with self._nonempty:
+            while not self._queue:
+                if not self._running:
+                    return None
+                self._nonempty.wait(timeout=0.05)
+            head = self._queue[0]
+            deadline = head.t_enq + self.max_wait_s
+
+            def ready_rows():
+                rows = 0
+                for r in self._queue:
+                    if (r.slot, r.deterministic) != (
+                        head.slot, head.deterministic
+                    ):
+                        break
+                    rows += r.rows
+                return rows
+
+            # A single oversized request flushes immediately (it fills
+            # max_batch on its own); otherwise wait for more rows until
+            # the head's deadline.
+            while self._running and ready_rows() < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._nonempty.wait(timeout=remaining)
+            group: t.List[_Request] = []
+            rows = 0
+            while self._queue:
+                r = self._queue[0]
+                if (r.slot, r.deterministic) != (head.slot, head.deterministic):
+                    break
+                if group and rows + r.rows > self.max_batch:
+                    break  # next group picks it up (oversized head is
+                    # taken alone and chunked by _run_group)
+                group.append(self._queue.popleft())
+                rows += r.rows
+                if rows >= self.max_batch:
+                    break
+            return group
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _run_group(self, group: t.List[_Request]):
+        try:
+            engine, params, generation = self.registry.acquire(group[0].slot)
+            det = group[0].deterministic
+            obs = group[0].obs
+            if len(group) > 1:
+                obs = jax.tree_util.tree_map(
+                    lambda *xs: np.concatenate(xs, axis=0),
+                    *[r.obs for r in group],
+                )
+            total = sum(r.rows for r in group)
+            # Chunk at max_batch (only an oversized single request
+            # exceeds it) and run one padded forward per chunk.
+            outs = []
+            for lo in range(0, total, self.max_batch):
+                chunk = jax.tree_util.tree_map(
+                    lambda x: x[lo:lo + self.max_batch], obs
+                )
+                n = min(self.max_batch, total - lo)
+                outs.append(engine.act(
+                    params, chunk,
+                    None if det else self._next_key(),
+                    deterministic=det,
+                ))
+                self.metrics.record_batch(
+                    rows=n, bucket=engine.bucket_for(n)
+                )
+            action = outs[0] if len(outs) == 1 else np.concatenate(outs, 0)
+            done_t = time.perf_counter()
+            lo = 0
+            for r in group:
+                r.future.set_result(
+                    ActResult(action[lo:lo + r.rows], generation)
+                )
+                self.metrics.record_done((done_t - r.t_enq) * 1e3)
+                lo += r.rows
+        except Exception as e:  # noqa: BLE001 — the dispatcher must
+            # survive a bad request/params; every caller sees the error.
+            for r in group:
+                if not r.future.done():
+                    r.future.set_exception(e)
+                self.metrics.record_error()
+
+    # -------------------------------------------------------------- admin
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def close(self, timeout: float = 10.0):
+        """Stop accepting work, flush everything queued, join the
+        dispatcher. Queued requests are answered, never dropped."""
+        with self._nonempty:
+            self._running = False
+            self._nonempty.notify_all()
+        self._thread.join(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,196 @@
+"""The jitted policy forward over a bucketed set of batch shapes.
+
+XLA compiles one program per input shape, so serving raw request-sized
+batches would compile an unbounded set of executables (and pay a
+multi-second compile on the first request of every new size — a latency
+cliff no service can absorb). The engine instead pads every batch up to
+a small fixed menu of power-of-two **buckets** and compiles exactly
+``len(buckets) x 2`` programs (deterministic / sampled), all warmed up
+front at startup. Padding rows are zeros; the pad is sliced off before
+the response leaves the engine, and row ``i`` of the output depends
+only on row ``i`` of the input (every model op is row-wise over the
+batch axis), so padded and unpadded forwards agree bitwise.
+
+The jit cache is keyed ``(bucket, deterministic)`` per engine instance;
+the registry holds one engine per model slot, which makes the full
+service-wide key the ISSUE's ``(bucket, deterministic, model_slot)``.
+
+Works for the flat :class:`~torch_actor_critic_tpu.models.actor.Actor`
+and the pytree-observation
+:class:`~torch_actor_critic_tpu.models.visual.VisualActor` alike: an
+observation is whatever pytree the model takes, and padding maps over
+its leaves. Deterministic serving returns the squashed-Gaussian mean
+(``tanh(mu) * act_limit``); sampled serving draws the reparameterized
+action with an explicit PRNG key.
+"""
+
+from __future__ import annotations
+
+import threading
+import typing as t
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PolicyEngine", "default_buckets"]
+
+
+def default_buckets(max_batch: int) -> t.Tuple[int, ...]:
+    """Powers of two ``2, 4, ... , max_batch`` (``max_batch`` itself is
+    always covered, rounded up to the next power of two).
+
+    The ladder starts at 2, not 1: XLA:CPU lowers a batch-1 matmul to a
+    matvec whose accumulation order differs in the last bit from the
+    gemm path every larger batch takes. Padding a lone request to 2
+    rows costs nothing and keeps responses **batch-shape invariant** —
+    the same observation returns the same bits whichever bucket it
+    lands in (pinned by tests/test_serve.py).
+    """
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    buckets = []
+    b = min(2, max_batch)
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(b)
+    return tuple(buckets)
+
+
+class PolicyEngine:
+    """Bucketed, jitted ``(params, obs, key) -> action`` for one actor.
+
+    ``actor_def`` is any module honoring the actor contract
+    ``apply(params, obs, key, deterministic, with_logprob)``;
+    ``obs_spec`` is the single-observation ShapeDtypeStruct pytree the
+    env layer exposes (``pool.obs_spec``). Thread-safe: jitted
+    executables are immutable once built, and the cache dict is guarded
+    for the build-on-miss path.
+    """
+
+    def __init__(
+        self,
+        actor_def,
+        obs_spec: t.Any,
+        max_batch: int = 64,
+        buckets: t.Sequence[int] | None = None,
+    ):
+        self.actor_def = actor_def
+        self.obs_spec = obs_spec
+        self.max_batch = int(max_batch)
+        self.buckets = tuple(sorted(set(
+            int(b) for b in (buckets or default_buckets(self.max_batch))
+        )))
+        if self.buckets[0] < 1:
+            raise ValueError(f"buckets must be >= 1, got {self.buckets}")
+        if self.buckets[-1] < self.max_batch:
+            raise ValueError(
+                f"largest bucket {self.buckets[-1]} < max_batch "
+                f"{self.max_batch}: requests between them could never "
+                "be padded to a compiled shape"
+            )
+        # Donating the padded obs/key buffers lets XLA reuse their HBM
+        # for the output on accelerators; on CPU donation is unsupported
+        # and only produces warnings, so gate it.
+        donate = jax.default_backend() not in ("cpu",)
+
+        def fwd_sampled(params, obs, key):
+            action, _ = self.actor_def.apply(
+                params, obs, key, deterministic=False, with_logprob=False
+            )
+            return action
+
+        def fwd_deterministic(params, obs):
+            action, _ = self.actor_def.apply(
+                params, obs, None, deterministic=True, with_logprob=False
+            )
+            return action
+
+        self._fwd = {
+            True: jax.jit(
+                fwd_deterministic, donate_argnums=(1,) if donate else ()
+            ),
+            False: jax.jit(
+                fwd_sampled, donate_argnums=(1, 2) if donate else ()
+            ),
+        }
+        self._compiled: set = set()  # {(bucket, deterministic)}
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------------- buckets
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (n must be <= max bucket)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(
+            f"batch of {n} rows exceeds the largest bucket "
+            f"{self.buckets[-1]}; the batcher must split it first"
+        )
+
+    def compiled_buckets(self) -> t.FrozenSet[t.Tuple[int, bool]]:
+        """The ``(bucket, deterministic)`` shapes traced so far — the
+        jit-cache keys this engine has populated."""
+        return frozenset(self._compiled)
+
+    # ----------------------------------------------------------- forward
+
+    def _pad(self, obs: t.Any, n: int, bucket: int) -> t.Any:
+        if n == bucket:
+            return obs
+
+        def pad_leaf(x):
+            pad = np.zeros((bucket - n,) + tuple(x.shape[1:]), dtype=x.dtype)
+            return np.concatenate([np.asarray(x), pad], axis=0)
+
+        return jax.tree_util.tree_map(pad_leaf, obs)
+
+    def act(
+        self,
+        params,
+        obs: t.Any,
+        key: jax.Array | None = None,
+        deterministic: bool = True,
+    ) -> np.ndarray:
+        """One padded forward; ``obs`` leaves carry a leading batch axis
+        of n <= max bucket rows; returns the n action rows."""
+        n = int(jax.tree_util.tree_leaves(obs)[0].shape[0])
+        bucket = self.bucket_for(n)
+        padded = self._pad(obs, n, bucket)
+        if deterministic:
+            out = self._fwd[True](params, padded)
+        else:
+            if key is None:
+                raise ValueError("sampled serving needs a PRNG key")
+            out = self._fwd[False](params, padded, key)
+        with self._lock:
+            self._compiled.add((bucket, bool(deterministic)))
+        return np.asarray(out)[:n]
+
+    # ------------------------------------------------------------ warmup
+
+    def warmup(
+        self,
+        params,
+        deterministic_only: bool = False,
+        buckets: t.Sequence[int] | None = None,
+    ) -> t.List[t.Tuple[int, bool]]:
+        """Trace + compile every ``(bucket, deterministic)`` program up
+        front so no live request ever pays a compile. Returns the list
+        of shapes warmed."""
+        warmed = []
+        key = jax.random.key(0)
+        for bucket in (buckets or self.buckets):
+            zero_obs = jax.tree_util.tree_map(
+                lambda s: np.zeros((bucket,) + tuple(s.shape), s.dtype),
+                self.obs_spec,
+            )
+            for det in (True,) if deterministic_only else (True, False):
+                out = self.act(
+                    params, zero_obs, None if det else key, deterministic=det
+                )
+                warmed.append((bucket, det))
+            del out
+        return warmed

@@ -1,0 +1,109 @@
+"""Serving metrics: queue depth, batch occupancy, rate, latency tails.
+
+A single lock-guarded accumulator shared by the batcher and the HTTP
+frontend. Latencies keep a bounded sliding window (default 8192
+samples) for percentile estimates — enough resolution for p99 at
+serving rates while bounding memory; total counters never reset, and
+:meth:`snapshot` derives requests/sec over the window between snapshots
+(falling back to lifetime rate on the first call).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import typing as t
+
+import numpy as np
+
+__all__ = ["ServeMetrics"]
+
+
+class ServeMetrics:
+    def __init__(self, latency_window: int = 8192):
+        self._lock = threading.Lock()
+        self._t_start = time.perf_counter()
+        self._t_snapshot = self._t_start
+        self.requests_total = 0
+        self.responses_total = 0
+        self.errors_total = 0
+        self.batches_total = 0
+        self.rows_total = 0
+        self.padded_rows_total = 0  # sum of bucket sizes dispatched
+        self.queue_depth = 0
+        self._responses_at_snapshot = 0
+        self._latencies_ms: collections.deque = collections.deque(
+            maxlen=latency_window
+        )
+
+    # ----------------------------------------------------------- recording
+
+    def record_enqueue(self, depth: int):
+        with self._lock:
+            self.requests_total += 1
+            self.queue_depth = depth
+
+    def record_batch(self, rows: int, bucket: int):
+        with self._lock:
+            self.batches_total += 1
+            self.rows_total += rows
+            self.padded_rows_total += bucket
+
+    def record_done(self, latency_ms: float):
+        with self._lock:
+            self.responses_total += 1
+            self._latencies_ms.append(latency_ms)
+
+    def record_error(self):
+        with self._lock:
+            self.errors_total += 1
+
+    # ------------------------------------------------------------ snapshot
+
+    def snapshot(self) -> t.Dict[str, t.Any]:
+        """Point-in-time metrics dict (the ``/metrics`` payload and the
+        bench JSON's ``serving`` keys)."""
+        with self._lock:
+            now = time.perf_counter()
+            window_s = now - self._t_snapshot
+            window_responses = self.responses_total - self._responses_at_snapshot
+            lifetime_s = now - self._t_start
+            self._t_snapshot = now
+            self._responses_at_snapshot = self.responses_total
+            lat = np.asarray(self._latencies_ms, dtype=np.float64)
+            out = {
+                "requests_total": self.requests_total,
+                "responses_total": self.responses_total,
+                "errors_total": self.errors_total,
+                "batches_total": self.batches_total,
+                "queue_depth": self.queue_depth,
+                "uptime_s": round(lifetime_s, 3),
+                # Occupancy: real rows per dispatched row slot — 1.0
+                # means every forward ran a full bucket, low values mean
+                # deadline flushes of tiny batches (tune max_wait_ms).
+                "mean_batch_occupancy": (
+                    round(self.rows_total / self.padded_rows_total, 4)
+                    if self.padded_rows_total else None
+                ),
+                "mean_rows_per_batch": (
+                    round(self.rows_total / self.batches_total, 2)
+                    if self.batches_total else None
+                ),
+                "requests_per_sec": round(
+                    (window_responses / window_s)
+                    if window_s > 1e-9 and window_responses
+                    else (self.responses_total / lifetime_s
+                          if lifetime_s > 1e-9 else 0.0),
+                    2,
+                ),
+            }
+        if lat.size:
+            p50, p95, p99 = np.percentile(lat, [50, 95, 99])
+            out.update(
+                p50_ms=round(float(p50), 3),
+                p95_ms=round(float(p95), 3),
+                p99_ms=round(float(p99), 3),
+                max_ms=round(float(lat.max()), 3),
+            )
+        return out

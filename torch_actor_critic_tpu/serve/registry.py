@@ -1,0 +1,221 @@
+"""Multi-slot model registry with Orbax checkpoint hot-reload.
+
+A serving process holds one or more named **slots** (e.g. ``default``,
+``canary``), each an immutable-at-a-glance triple
+``(engine, params, generation)``. Swaps are atomic: the triple is
+replaced in one reference assignment under the slot lock, the
+generation counter increments, and any batch already dispatched keeps
+the triple it captured — in-flight requests finish on the OLD weights
+and nothing is ever dropped or recompiled mid-request (the engine and
+its bucketed jit cache survive a swap; only params change).
+
+Hot-reload sources a slot from the training run's Orbax checkpoint
+directory (:class:`~torch_actor_critic_tpu.utils.checkpoint.Checkpointer`
+layout): :meth:`reload` checks ``latest_step`` against the slot's
+loaded epoch and swaps when the trainer has written a newer one —
+called manually (the HTTP ``/reload`` endpoint) or by the background
+poller (:meth:`start_polling`).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import typing as t
+
+from torch_actor_critic_tpu.serve.engine import PolicyEngine
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ModelRegistry"]
+
+
+class _Slot:
+    __slots__ = ("engine", "state", "checkpointer", "lock")
+
+    def __init__(self, engine, params, epoch, checkpointer):
+        self.engine = engine
+        # (params, generation, epoch): swapped as ONE tuple so readers
+        # can never observe a params/generation mismatch.
+        self.state = (params, 0, epoch)
+        self.checkpointer = checkpointer
+        self.lock = threading.Lock()
+
+
+class ModelRegistry:
+    def __init__(self):
+        self._slots: t.Dict[str, _Slot] = {}
+        self._lock = threading.Lock()
+        self._poller: threading.Thread | None = None
+        self._poll_stop = threading.Event()
+
+    # ------------------------------------------------------- registration
+
+    def register(
+        self,
+        name: str,
+        actor_def,
+        obs_spec,
+        params=None,
+        ckpt_dir: str | None = None,
+        max_batch: int = 64,
+        buckets: t.Sequence[int] | None = None,
+        warmup: bool = True,
+    ) -> dict:
+        """Create a slot. ``params`` seeds it directly (tests/bench);
+        ``ckpt_dir`` loads the latest epoch from an Orbax dir and arms
+        hot-reload for it. Exactly one of the two is required.
+        ``warmup`` compiles every bucket before the slot goes live, so
+        the first live request never pays a compile."""
+        if (params is None) == (ckpt_dir is None):
+            raise ValueError("pass exactly one of params / ckpt_dir")
+        engine = PolicyEngine(
+            actor_def, obs_spec, max_batch=max_batch, buckets=buckets
+        )
+        checkpointer = None
+        epoch = None
+        if ckpt_dir is not None:
+            from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
+
+            checkpointer = Checkpointer(ckpt_dir, save_buffer=False)
+            params, meta = checkpointer.restore_actor_params()
+            epoch = meta["epoch"]
+        if warmup:
+            engine.warmup(params)
+        slot = _Slot(engine, params, epoch, checkpointer)
+        with self._lock:
+            self._slots[name] = slot
+        logger.info(
+            "registered slot %r (epoch=%s, buckets=%s, warmup=%s)",
+            name, epoch, engine.buckets, warmup,
+        )
+        return {"slot": name, "epoch": epoch, "generation": 0}
+
+    # ------------------------------------------------------------ reading
+
+    def _slot(self, name: str) -> _Slot:
+        try:
+            return self._slots[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown model slot {name!r}; have {sorted(self._slots)}"
+            ) from None
+
+    def acquire(self, name: str = "default"):
+        """``(engine, params, generation)`` — the triple a batch runs
+        with. The caller keeps these references for the whole forward;
+        a concurrent swap cannot mutate them."""
+        slot = self._slot(name)
+        with slot.lock:
+            params, generation, _ = slot.state
+        return slot.engine, params, generation
+
+    def slots(self) -> t.Dict[str, dict]:
+        """Health/introspection view of every slot."""
+        out = {}
+        with self._lock:
+            items = list(self._slots.items())
+        for name, slot in items:
+            with slot.lock:
+                _, generation, epoch = slot.state
+            out[name] = {
+                "generation": generation,
+                "epoch": epoch,
+                "hot_reload": slot.checkpointer is not None,
+                "buckets": list(slot.engine.buckets),
+                "compiled": sorted(
+                    [list(k) for k in slot.engine.compiled_buckets()]
+                ),
+            }
+        return out
+
+    # --------------------------------------------------------- hot reload
+
+    def swap(self, name: str, params, epoch: int | None = None) -> int:
+        """Atomically install new params; returns the new generation."""
+        slot = self._slot(name)
+        with slot.lock:
+            _, generation, old_epoch = slot.state
+            slot.state = (
+                params, generation + 1,
+                epoch if epoch is not None else old_epoch,
+            )
+            return generation + 1
+
+    def reload(self, name: str | None = None) -> t.Dict[str, dict]:
+        """Check checkpoint-backed slots for a newer epoch; swap those
+        that have one. Returns per-slot status."""
+        with self._lock:
+            names = [name] if name is not None else list(self._slots)
+        out = {}
+        for n in names:
+            slot = self._slot(n)
+            if slot.checkpointer is None:
+                out[n] = {"reloaded": False, "reason": "no checkpoint dir"}
+                continue
+            with slot.lock:
+                _, generation, loaded_epoch = slot.state
+            try:
+                # The Orbax manager caches its step list; refresh to see
+                # epochs the TRAINER process wrote since our last look.
+                slot.checkpointer.refresh()
+                latest = slot.checkpointer.latest_epoch()
+                if latest is None or (
+                    loaded_epoch is not None and latest <= loaded_epoch
+                ):
+                    out[n] = {
+                        "reloaded": False, "epoch": loaded_epoch,
+                        "generation": generation,
+                    }
+                    continue
+                # Restore OUTSIDE the slot lock: a multi-second Orbax
+                # read must not stall acquire() (live traffic keeps
+                # flowing on the old params until the swap below).
+                params, meta = slot.checkpointer.restore_actor_params(latest)
+                generation = self.swap(n, params, epoch=latest)
+                out[n] = {
+                    "reloaded": True, "epoch": latest,
+                    "generation": generation,
+                }
+                logger.info(
+                    "slot %r hot-reloaded epoch %s (generation %s)",
+                    n, latest, generation,
+                )
+            except Exception as e:  # noqa: BLE001 — a half-written or
+                # corrupt checkpoint must not take serving down; the
+                # slot keeps its current params and reports the error.
+                logger.warning("slot %r reload failed: %r", n, e)
+                out[n] = {"reloaded": False, "error": repr(e)[:200]}
+        return out
+
+    def start_polling(self, interval_s: float = 5.0):
+        """Background hot-reload: poll checkpoint dirs every
+        ``interval_s`` seconds."""
+        if self._poller is not None:
+            raise RuntimeError("poller already running")
+        self._poll_stop.clear()
+
+        def loop():
+            while not self._poll_stop.wait(timeout=interval_s):
+                self.reload()
+
+        self._poller = threading.Thread(
+            target=loop, name="ckpt-poller", daemon=True
+        )
+        self._poller.start()
+
+    def stop_polling(self):
+        if self._poller is None:
+            return
+        self._poll_stop.set()
+        self._poller.join(timeout=10.0)
+        self._poller = None
+
+    def close(self):
+        self.stop_polling()
+        with self._lock:
+            slots = list(self._slots.values())
+        for slot in slots:
+            if slot.checkpointer is not None:
+                slot.checkpointer.close()

@@ -1,0 +1,229 @@
+"""JSON frontends over the batcher: in-process client and HTTP server.
+
+:class:`PolicyClient` is the zero-copy path for tests, benchmarks and
+co-located actors: observations go straight into the micro-batching
+queue as numpy arrays.
+
+:class:`PolicyServer` is a stdlib ``ThreadingHTTPServer`` speaking
+JSON — deliberately dependency-free (the container bakes no web
+framework) and good for tens of thousands of requests/sec of small
+observations, since each handler thread only parses JSON and parks on
+a Future while the single dispatcher thread does the real (batched)
+work:
+
+- ``POST /act``     ``{"obs": [...] | {"features": [...], "frame": [...]},
+  "deterministic": bool, "model": "default"}`` ->
+  ``{"action": [...], "generation": N, "model": "..."}``
+- ``GET /healthz``  liveness + per-slot generation/epoch
+- ``GET /metrics``  :meth:`~torch_actor_critic_tpu.serve.metrics.ServeMetrics.snapshot`
+- ``POST /reload``  force a checkpoint poll now (hot-reload check)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import typing as t
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import numpy as np
+
+from torch_actor_critic_tpu.core.types import MultiObservation
+from torch_actor_critic_tpu.serve.batcher import ActResult, MicroBatcher
+from torch_actor_critic_tpu.serve.metrics import ServeMetrics
+from torch_actor_critic_tpu.serve.registry import ModelRegistry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["PolicyClient", "PolicyServer"]
+
+
+class PolicyClient:
+    """Direct in-process access to the serving stack — same batching,
+    no HTTP. One per process is enough; it is thread-safe."""
+
+    def __init__(self, registry: ModelRegistry, batcher: MicroBatcher):
+        self.registry = registry
+        self.batcher = batcher
+
+    def act(
+        self,
+        obs: t.Any,
+        deterministic: bool = True,
+        slot: str = "default",
+        timeout: float | None = 30.0,
+    ) -> ActResult:
+        return self.batcher.act(obs, deterministic, slot, timeout=timeout)
+
+    def act_async(
+        self, obs: t.Any, deterministic: bool = True, slot: str = "default"
+    ):
+        return self.batcher.submit(obs, deterministic, slot)
+
+
+def _parse_obs(raw, obs_spec):
+    """JSON observation -> numpy pytree matching ``obs_spec`` dtypes.
+
+    Flat models take a plain (nested) list; visual models take
+    ``{"features": ..., "frame": ...}`` (frames as uint8 nested lists).
+    """
+    if isinstance(obs_spec, MultiObservation):
+        if not isinstance(raw, dict) or set(raw) != {"features", "frame"}:
+            raise ValueError(
+                'visual slot expects obs {"features": [...], "frame": [...]}'
+            )
+        return MultiObservation(
+            features=np.asarray(
+                raw["features"], dtype=obs_spec.features.dtype
+            ),
+            frame=np.asarray(raw["frame"], dtype=obs_spec.frame.dtype),
+        )
+    return np.asarray(raw, dtype=obs_spec.dtype)
+
+
+class PolicyServer:
+    """HTTP frontend owning the registry's batcher + metrics.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port`` —
+    the serve-smoke and test harness path). ``start()`` serves on a
+    daemon thread; ``serve_forever()`` blocks (the CLI path).
+    """
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+        metrics: ServeMetrics | None = None,
+        seed: int = 0,
+    ):
+        self.registry = registry
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.batcher = MicroBatcher(
+            registry, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            metrics=self.metrics, seed=seed,
+        )
+        self.client = PolicyClient(registry, self.batcher)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # Keep the stdlib's per-request stderr lines out of the
+            # serving hot path; route to logging at debug level.
+            def log_message(self, fmt, *args):  # noqa: A003
+                logger.debug("http: " + fmt, *args)
+
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — stdlib API
+                if self.path == "/healthz":
+                    self._send(200, {
+                        "status": "ok",
+                        "queue_depth": server.batcher.queue_depth(),
+                        "slots": server.registry.slots(),
+                    })
+                elif self.path == "/metrics":
+                    self._send(200, server.metrics.snapshot())
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):  # noqa: N802 — stdlib API
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length) if length else b"{}"
+                    body = json.loads(raw or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"bad JSON body: {e}"})
+                    return
+                if self.path == "/act":
+                    self._act(body)
+                elif self.path == "/reload":
+                    self._send(200, {
+                        "reload": server.registry.reload(body.get("model"))
+                    })
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def _act(self, body: dict):
+                slot = body.get("model", "default")
+                try:
+                    engine, _, _ = server.registry.acquire(slot)
+                except KeyError as e:
+                    self._send(404, {"error": str(e)})
+                    return
+                if "obs" not in body:
+                    self._send(400, {"error": 'missing "obs"'})
+                    return
+                try:
+                    obs = _parse_obs(body["obs"], engine.obs_spec)
+                    res = server.client.act(
+                        obs,
+                        deterministic=bool(body.get("deterministic", True)),
+                        slot=slot,
+                    )
+                except (ValueError, TypeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 — engine failure
+                    logger.exception("act failed")
+                    self._send(500, {"error": repr(e)[:500]})
+                    return
+                self._send(200, {
+                    "action": np.asarray(res.action).tolist(),
+                    "generation": res.generation,
+                    "model": slot,
+                })
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self):
+        """Serve on a background daemon thread (tests, smoke)."""
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="policy-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        """Block serving until interrupted (the CLI path)."""
+        try:
+            self._httpd.serve_forever()
+        except KeyboardInterrupt:  # pragma: no cover — operator stop
+            pass
+        finally:
+            self.close()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self.batcher.close()
+        self.registry.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

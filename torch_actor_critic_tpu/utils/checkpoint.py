@@ -35,6 +35,42 @@ CKPT_FORMAT = 3  # 3: VisualDoubleCritic ensemble unrolled (ensemble_i
 # 'ensemble' with a stacked leading axis) no longer restore
 
 
+def _is_prng_key(x) -> bool:
+    dt = getattr(x, "dtype", None)
+    return dt is not None and jax.dtypes.issubdtype(dt, jax.dtypes.prng_key)
+
+
+def _unwrap_prng_keys(tree):
+    """Typed PRNG-key leaves -> raw uint32 key data.
+
+    The orbax in this image cannot serialize extended-dtype (typed key)
+    arrays (``np.array(key)`` raises inside its serializer), so key
+    leaves cross the checkpoint boundary as their underlying uint32
+    bits — same information, stable on-disk layout on every jax
+    version. Applied symmetrically on save and on the abstract restore
+    tree; :func:`_rewrap_prng_keys` restores the typed view.
+    """
+    return jax.tree_util.tree_map(
+        lambda x: jax.random.key_data(x) if _is_prng_key(x) else x, tree
+    )
+
+
+def _rewrap_prng_keys(restored, reference):
+    """Re-wrap raw uint32 key data as typed keys wherever ``reference``
+    (the caller's abstract tree, pre-unwrap) holds a typed key."""
+
+    def rewrap(r, ref):
+        if not _is_prng_key(ref):
+            return r
+        try:
+            impl = jax.random.key_impl(ref)
+        except Exception:  # abstract leaf without impl info
+            impl = None
+        return jax.random.wrap_key_data(r, impl=impl)
+
+    return jax.tree_util.tree_map(rewrap, restored, reference)
+
+
 def _has_unrolled_visual_ensemble(train_state: TrainState) -> bool:
     """True when the critic tree is a format-3 unrolled visual ensemble
     (``ensemble_i`` submodules, models/visual.py) — the ONLY family
@@ -74,7 +110,7 @@ class Checkpointer:
     ) -> None:
         """Write checkpoint for ``epoch`` (async unless ``wait``)."""
         items = {
-            "train_state": ocp.args.StandardSave(train_state),
+            "train_state": ocp.args.StandardSave(_unwrap_prng_keys(train_state)),
             "meta": ocp.args.JsonSave(
                 dict(extra or {}, epoch=int(epoch), ckpt_format=CKPT_FORMAT)
             ),
@@ -142,7 +178,9 @@ class Checkpointer:
                 "framework version that wrote it."
             )
         items = {
-            "train_state": ocp.args.StandardRestore(abstract_train_state),
+            "train_state": ocp.args.StandardRestore(
+                _unwrap_prng_keys(abstract_train_state)
+            ),
             "meta": ocp.args.JsonRestore(),
         }
         # Only request the buffer if this checkpoint actually contains
@@ -166,7 +204,62 @@ class Checkpointer:
         if abstract_buffer is not None and "buffer" in saved_items:
             items["buffer"] = ocp.args.StandardRestore(abstract_buffer)
         out = self._mgr.restore(epoch, args=ocp.args.Composite(**items))
-        return out["train_state"], out.get("buffer"), dict(out["meta"])
+        train_state = _rewrap_prng_keys(
+            out["train_state"], abstract_train_state
+        )
+        return train_state, out.get("buffer"), dict(out["meta"])
+
+    def restore_actor_params(
+        self, epoch: int | None = None
+    ) -> t.Tuple[t.Any, dict]:
+        """``(actor_params, meta)`` of a checkpoint — the serving path.
+
+        Unlike :meth:`restore` this needs NO abstract tree: the policy
+        service knows only the actor module, not the critic/optimizer
+        structure, so the ``train_state`` item is restored shape-from-
+        disk (the replay ``buffer`` item is never requested — for a
+        1M-transition run that is the difference between touching a few
+        MB and tens of GB) and the actor subtree extracted. Params come
+        back as a plain nested dict, which is exactly what
+        ``actor_def.apply`` takes.
+        """
+        epoch = epoch if epoch is not None else self._mgr.latest_step()
+        if epoch is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        # The shape-from-disk restore makes Orbax warn that a target
+        # tree "is generally UNSAFE" — for serving the disk layout IS
+        # the contract (the engine validates by applying the params),
+        # so the warning is noise; silenced as in restore() above.
+        import logging as _logging
+
+        absl_logger = _logging.getLogger("absl")
+        prev_level = absl_logger.level
+        absl_logger.setLevel(_logging.ERROR)
+        try:
+            out = self._mgr.restore(
+                epoch,
+                args=ocp.args.Composite(
+                    train_state=ocp.args.StandardRestore(),
+                    meta=ocp.args.JsonRestore(),
+                ),
+            )
+        finally:
+            absl_logger.setLevel(prev_level)
+        train_state = out["train_state"]
+        if "actor_params" not in train_state:
+            raise KeyError(
+                f"checkpoint at {self.directory} epoch {epoch} has no "
+                "actor_params item — not a TrainState checkpoint?"
+            )
+        return train_state["actor_params"], dict(out["meta"], epoch=epoch)
+
+    def refresh(self) -> None:
+        """Re-read the checkpoint directory. The manager caches its
+        step list at construction and only updates it through its OWN
+        saves — a reader polling for steps written by ANOTHER process
+        (the serving hot-reload path) must refresh first or
+        ``latest_epoch`` stays frozen at construction time."""
+        self._mgr.reload()
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
