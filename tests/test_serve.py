@@ -58,7 +58,11 @@ def make_registry(max_batch=8, warmup=False, **kw):
 
 def test_default_buckets_power_of_two():
     assert default_buckets(64) == (2, 4, 8, 16, 32, 64)
-    assert default_buckets(1) == (1,)
+    # the ladder always starts at 2 — a max_batch=1 engine pads its
+    # lone request up to the 2-row bucket so responses stay
+    # batch-shape invariant (batch-1 matvec vs gemm last-bit drift)
+    assert default_buckets(1) == (2,)
+    assert default_buckets(2) == (2,)
     # non-power-of-two max rounds the top bucket up, never down
     assert default_buckets(48)[-1] == 64
 
@@ -235,6 +239,56 @@ def test_unknown_slot_raises_immediately():
     with MicroBatcher(reg, max_batch=4, max_wait_ms=1.0) as mb:
         with pytest.raises(KeyError, match="unknown model slot"):
             mb.act(np.ones((OBS_DIM,), np.float32), slot="nope")
+
+
+def test_batcher_chunks_at_engine_max_batch():
+    """A slot registered with a SMALLER max_batch than the batcher's
+    must still serve full-size requests: chunks honor the engine's own
+    bucket ceiling, not just the batcher's."""
+    reg, actor, params = make_registry(max_batch=4)
+    n = 10  # > engine max_batch, < batcher max_batch
+    obs = np.random.default_rng(5).standard_normal((n, OBS_DIM)).astype(
+        np.float32
+    )
+    with MicroBatcher(reg, max_batch=16, max_wait_ms=1.0) as mb:
+        res = mb.act(obs, timeout=60.0)
+        assert res.action.shape == (n, ACT_DIM)
+        snap = mb.metrics.snapshot()
+        assert snap["batches_total"] == 3  # ceil(10/4)
+        assert snap["errors_total"] == 0
+    for i in range(n):
+        single, _ = actor.apply(
+            params, jnp.asarray(obs[i]), None,
+            deterministic=True, with_logprob=False,
+        )
+        np.testing.assert_array_equal(res.action[i], np.asarray(single))
+
+
+def test_duplicate_slot_registration_raises_unless_replace():
+    reg, actor, params = make_registry(max_batch=4)
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register(
+            "default", actor, flat_spec(), params=params,
+            max_batch=4, warmup=False,
+        )
+    info = reg.register(
+        "default", actor, flat_spec(), params=params,
+        max_batch=4, warmup=False, replace=True,
+    )
+    assert info["generation"] == 0
+
+
+def test_metrics_idle_window_reports_zero_rate():
+    """After the first snapshot, an idle inter-snapshot window reports
+    requests_per_sec == 0.0 — not a stale lifetime rate."""
+    reg, _, _ = make_registry(max_batch=4)
+    with MicroBatcher(reg, max_batch=4, max_wait_ms=1.0) as mb:
+        mb.act(np.ones((OBS_DIM,), np.float32), timeout=60.0)
+        first = mb.metrics.snapshot()  # lifetime fallback: saw traffic
+        assert first["requests_per_sec"] > 0
+        time.sleep(0.01)  # idle window
+        idle = mb.metrics.snapshot()
+        assert idle["requests_per_sec"] == 0.0
 
 
 # -------------------------------------------------------------- hot reload

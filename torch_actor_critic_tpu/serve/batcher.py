@@ -241,14 +241,19 @@ class MicroBatcher:
                     *[r.obs for r in group],
                 )
             total = sum(r.rows for r in group)
-            # Chunk at max_batch (only an oversized single request
-            # exceeds it) and run one padded forward per chunk.
+            # Chunk and run one padded forward per chunk. The chunk
+            # size honors BOTH ceilings: the batcher's max_batch (only
+            # an oversized single request exceeds it) and the engine's
+            # own max_batch — a slot may be registered with a smaller
+            # bucket ladder than the server-wide batcher, and chunks
+            # larger than its top bucket would make bucket_for raise.
+            chunk_rows = min(self.max_batch, engine.max_batch)
             outs = []
-            for lo in range(0, total, self.max_batch):
+            for lo in range(0, total, chunk_rows):
                 chunk = jax.tree_util.tree_map(
-                    lambda x: x[lo:lo + self.max_batch], obs
+                    lambda x, lo=lo: x[lo:lo + chunk_rows], obs
                 )
-                n = min(self.max_batch, total - lo)
+                n = min(chunk_rows, total - lo)
                 outs.append(engine.act(
                     params, chunk,
                     None if det else self._next_key(),

@@ -40,17 +40,18 @@ def default_buckets(max_batch: int) -> t.Tuple[int, ...]:
     """Powers of two ``2, 4, ... , max_batch`` (``max_batch`` itself is
     always covered, rounded up to the next power of two).
 
-    The ladder starts at 2, not 1: XLA:CPU lowers a batch-1 matmul to a
-    matvec whose accumulation order differs in the last bit from the
-    gemm path every larger batch takes. Padding a lone request to 2
-    rows costs nothing and keeps responses **batch-shape invariant** —
-    the same observation returns the same bits whichever bucket it
-    lands in (pinned by tests/test_serve.py).
+    The ladder starts at 2, not 1 — even for ``max_batch=1``, whose
+    lone request is padded up to a 2-row bucket: XLA:CPU lowers a
+    batch-1 matmul to a matvec whose accumulation order differs in the
+    last bit from the gemm path every larger batch takes. Padding a
+    lone request to 2 rows costs nothing and keeps responses
+    **batch-shape invariant** — the same observation returns the same
+    bits whichever bucket it lands in (pinned by tests/test_serve.py).
     """
     if max_batch < 1:
         raise ValueError(f"max_batch must be >= 1, got {max_batch}")
     buckets = []
-    b = min(2, max_batch)
+    b = 2
     while b < max_batch:
         buckets.append(b)
         b *= 2
@@ -90,9 +91,11 @@ class PolicyEngine:
                 f"{self.max_batch}: requests between them could never "
                 "be padded to a compiled shape"
             )
-        # Donating the padded obs/key buffers lets XLA reuse their HBM
-        # for the output on accelerators; on CPU donation is unsupported
-        # and only produces warnings, so gate it.
+        # Donating the padded obs buffer lets XLA reuse its HBM for the
+        # output on accelerators; on CPU donation is unsupported and
+        # only produces warnings, so gate it. The PRNG key is NOT
+        # donated: its buffer is tiny, and donation would delete any
+        # key a caller holds across calls.
         donate = jax.default_backend() not in ("cpu",)
 
         def fwd_sampled(params, obs, key):
@@ -112,7 +115,7 @@ class PolicyEngine:
                 fwd_deterministic, donate_argnums=(1,) if donate else ()
             ),
             False: jax.jit(
-                fwd_sampled, donate_argnums=(1, 2) if donate else ()
+                fwd_sampled, donate_argnums=(1,) if donate else ()
             ),
         }
         self._compiled: set = set()  # {(bucket, deterministic)}
@@ -188,9 +191,11 @@ class PolicyEngine:
                 self.obs_spec,
             )
             for det in (True,) if deterministic_only else (True, False):
-                out = self.act(
-                    params, zero_obs, None if det else key, deterministic=det
-                )
+                if det:
+                    sub = None
+                else:
+                    key, sub = jax.random.split(key)
+                out = self.act(params, zero_obs, sub, deterministic=det)
                 warmed.append((bucket, det))
             del out
         return warmed

@@ -33,6 +33,7 @@ class ServeMetrics:
         self.padded_rows_total = 0  # sum of bucket sizes dispatched
         self.queue_depth = 0
         self._responses_at_snapshot = 0
+        self._snapshots_taken = 0
         self._latencies_ms: collections.deque = collections.deque(
             maxlen=latency_window
         )
@@ -69,6 +70,8 @@ class ServeMetrics:
             window_s = now - self._t_snapshot
             window_responses = self.responses_total - self._responses_at_snapshot
             lifetime_s = now - self._t_start
+            first_snapshot = self._snapshots_taken == 0
+            self._snapshots_taken += 1
             self._t_snapshot = now
             self._responses_at_snapshot = self.responses_total
             lat = np.asarray(self._latencies_ms, dtype=np.float64)
@@ -90,11 +93,17 @@ class ServeMetrics:
                     round(self.rows_total / self.batches_total, 2)
                     if self.batches_total else None
                 ),
+                # Rate over the window since the previous snapshot. The
+                # lifetime fallback applies ONLY to the very first
+                # snapshot (no window exists yet); afterwards an idle
+                # window honestly reports 0.0 instead of echoing a
+                # stale lifetime rate.
                 "requests_per_sec": round(
-                    (window_responses / window_s)
-                    if window_s > 1e-9 and window_responses
-                    else (self.responses_total / lifetime_s
-                          if lifetime_s > 1e-9 else 0.0),
+                    (self.responses_total / lifetime_s
+                     if lifetime_s > 1e-9 else 0.0)
+                    if first_snapshot
+                    else (window_responses / window_s
+                          if window_s > 1e-9 else 0.0),
                     2,
                 ),
             }

@@ -62,14 +62,30 @@ class ModelRegistry:
         max_batch: int = 64,
         buckets: t.Sequence[int] | None = None,
         warmup: bool = True,
+        replace: bool = False,
     ) -> dict:
         """Create a slot. ``params`` seeds it directly (tests/bench);
         ``ckpt_dir`` loads the latest epoch from an Orbax dir and arms
         hot-reload for it. Exactly one of the two is required.
         ``warmup`` compiles every bucket before the slot goes live, so
-        the first live request never pays a compile."""
+        the first live request never pays a compile.
+
+        Registering a name that already exists raises unless
+        ``replace=True`` — a silent overwrite would discard the old
+        slot's engine/checkpointer and restart its generation counter
+        at 0, which clients tracking generations would see as the
+        counter going backwards. With ``replace=True`` the displaced
+        slot's checkpointer is closed and the replacement is logged."""
         if (params is None) == (ckpt_dir is None):
             raise ValueError("pass exactly one of params / ckpt_dir")
+        with self._lock:
+            exists = name in self._slots
+        if exists and not replace:
+            raise ValueError(
+                f"model slot {name!r} already registered; pass "
+                "replace=True to displace it (resets its generation "
+                "counter to 0)"
+            )
         engine = PolicyEngine(
             actor_def, obs_spec, max_batch=max_batch, buckets=buckets
         )
@@ -85,7 +101,15 @@ class ModelRegistry:
             engine.warmup(params)
         slot = _Slot(engine, params, epoch, checkpointer)
         with self._lock:
+            displaced = self._slots.get(name)
             self._slots[name] = slot
+        if displaced is not None:
+            logger.warning(
+                "slot %r replaced; generation counter restarts at 0",
+                name,
+            )
+            if displaced.checkpointer is not None:
+                displaced.checkpointer.close()
         logger.info(
             "registered slot %r (epoch=%s, buckets=%s, warmup=%s)",
             name, epoch, engine.buckets, warmup,
