@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke
+.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -45,6 +45,12 @@ serve:
 # /healthz round-trip; exits nonzero on failure.
 serve-smoke:
 	JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+
+# Fault-injection suite: every recovery path (NaN rollback, SIGTERM
+# save+requeue+bitwise resume, checkpoint retry/fallback, dead env
+# worker) driven through a real Trainer (docs/RESILIENCE.md).
+fault-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q -m "not slow"
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

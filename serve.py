@@ -57,6 +57,12 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                      help="Checkpoint hot-reload poll seconds (0 = off)")
     srv.add_argument("--seed", type=int, default=0,
                      help="PRNG seed for sampled (non-deterministic) acting")
+    srv.add_argument("--request-timeout", type=float, default=30.0,
+                     help="Per-connection socket timeout in seconds (a "
+                          "stalled client frees its handler thread)")
+    srv.add_argument("--act-timeout", type=float, default=30.0,
+                     help="Max seconds to wait on the batcher before "
+                          "answering 503 + Retry-After")
     return p.parse_args(argv)
 
 
@@ -149,6 +155,8 @@ def main(argv=None):
         registry, host=args.host, port=args.port,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         seed=args.seed,
+        request_timeout_s=args.request_timeout,
+        act_timeout_s=args.act_timeout,
     )
     print(json.dumps({
         "serving": server.address, "slots": registry.slots(),

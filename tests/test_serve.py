@@ -472,6 +472,49 @@ def test_http_act_healthz_metrics_reload_roundtrip():
         assert e.value.code == 404
 
 
+def test_batcher_timeout_maps_to_503_with_retry_after():
+    """Resilience satellite (ISSUE 2): a stalled policy backend must
+    answer 503 + Retry-After (back off and retry), not a generic 500
+    (broken, page someone) — and every connection carries a socket
+    timeout so a stalled client cannot wedge a handler thread forever.
+    The stall is a real one: the engine forward blocks on an event the
+    test controls, so the batcher future deterministically exceeds the
+    server's act deadline. No sleeps, no races."""
+    reg, actor, params = make_registry(max_batch=4)
+    engine, _, _ = reg.acquire("default")
+    release = threading.Event()
+    real_act = engine.act
+
+    def stalled_act(*args, **kwargs):
+        release.wait(30.0)
+        return real_act(*args, **kwargs)
+
+    engine.act = stalled_act
+    try:
+        with PolicyServer(
+            reg, port=0, max_batch=4, max_wait_ms=1.0,
+            request_timeout_s=12.5, act_timeout_s=0.2,
+        ) as srv:
+            srv.start()
+            # The per-connection socket timeout reaches the stdlib
+            # handler (applied via connection.settimeout in setup()).
+            assert srv._httpd.RequestHandlerClass.timeout == 12.5
+            req = urlreq.Request(
+                srv.address + "/act",
+                data=json.dumps({"obs": [0.0] * OBS_DIM}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urlreq.HTTPError) as e:
+                urlreq.urlopen(req, timeout=30)
+            assert e.value.code == 503
+            assert e.value.headers["Retry-After"] == "1"
+            assert "timed out" in json.loads(e.value.read())["error"]
+            release.set()  # unblock the dispatcher before shutdown
+    finally:
+        release.set()
+        engine.act = real_act
+
+
 def test_http_batched_obs():
     reg, actor, params = make_registry(max_batch=4)
     with PolicyServer(reg, port=0, max_batch=4, max_wait_ms=1.0) as srv:

@@ -477,8 +477,15 @@ class ParallelEnvPool:
                     detail = f"\nworker traceback:\n{payload}"
         except (EOFError, OSError):  # pipe died with the worker
             pass
+        if alive:
+            state = "hung"
+        else:
+            # Reap first so exitcode is populated (a SIGKILLed child is
+            # a zombie until joined); negative exitcode == -signal.
+            self._procs[i].join(timeout=1)
+            state = f"died (exitcode {self._procs[i].exitcode})"
         raise RuntimeError(
-            f"env worker {i} {'hung' if alive else 'died'} "
+            f"env worker {i} {state} "
             f"(env={self.env_name}, timeout={self.timeout_ms}ms){detail}"
         )
 
@@ -567,18 +574,56 @@ class ParallelEnvPool:
         self._wait([i])
 
     def close(self):
+        """Bounded teardown, safe after worker death: every wait below
+        carries a timeout and escalates (CLOSE -> terminate -> kill),
+        so a worker that died mid-episode — or one wedged inside a
+        native env step — can never hang shutdown (the reference's
+        dead-rank ``comm.recv`` hangs forever, SURVEY.md §5)."""
         if getattr(self, "_closed", True):
             return
         self._closed = True
         atexit.unregister(self.close)
         try:
+            # Poll (with zero timeout) before the joins: a worker that
+            # died mid-episode may have left a traceback in its pipe —
+            # surface it as a warning instead of silently dropping it.
+            for i, conn in enumerate(self._conns):
+                try:
+                    if conn.poll(0):
+                        tag, payload = conn.recv()
+                        if tag == "error":
+                            logger.warning(
+                                "env worker %d reported during close:\n%s",
+                                i, payload,
+                            )
+                except (EOFError, OSError):  # died without a message
+                    pass
             live = [i for i, p in enumerate(self._procs) if p.is_alive()]
             self._dispatch(live, CMD_CLOSE)
             for p in self._procs:
                 p.join(timeout=2)
-                if p.is_alive():
-                    p.terminate()
+            for escalate in ("terminate", "kill"):
+                stragglers = [p for p in self._procs if p.is_alive()]
+                if not stragglers:
+                    break
+                for p in stragglers:
+                    getattr(p, escalate)()
+                for p in stragglers:
+                    p.join(timeout=2)
+            dead = {
+                i: p.exitcode
+                for i, p in enumerate(self._procs)
+                if p.exitcode not in (0, None)
+            }
+            if dead:
+                logger.warning(
+                    "env workers exited abnormally: %s",
+                    ", ".join(f"worker {i}: exitcode {c}"
+                              for i, c in dead.items()),
+                )
         finally:
+            for conn in self._conns:
+                conn.close()
             del self._ctrl, self._data, self._obs_views
             self._shm.close()
             try:

@@ -1,0 +1,38 @@
+"""Preemption-safe, self-healing training (docs/RESILIENCE.md).
+
+The subsystem that makes multi-day runs on preemptible TPU slices
+survivable without human intervention (the ROADMAP's "as fast as the
+hardware allows" presumes the run is still alive to be fast):
+
+- :mod:`~torch_actor_critic_tpu.resilience.sentinel` — divergence
+  detection + bounded rollback-to-last-good-checkpoint policy;
+- :mod:`~torch_actor_critic_tpu.resilience.preemption` — SIGTERM/
+  SIGINT -> emergency save -> distinct requeue exit code;
+- :mod:`~torch_actor_critic_tpu.resilience.retry` — bounded
+  retry-with-backoff for flaky checkpoint IO;
+- :mod:`~torch_actor_critic_tpu.resilience.faultinject` — the harness
+  that injects each fault class into a real Trainer so every recovery
+  path is *proven* in CI, not hoped for.
+"""
+
+from torch_actor_critic_tpu.resilience.preemption import (
+    REQUEUE_EXIT_CODE,
+    Preempted,
+    PreemptionGuard,
+)
+from torch_actor_critic_tpu.resilience.retry import call_with_retries
+from torch_actor_critic_tpu.resilience.sentinel import (
+    DivergenceSentinel,
+    TrainingDiverged,
+    tree_all_finite,
+)
+
+__all__ = [
+    "REQUEUE_EXIT_CODE",
+    "Preempted",
+    "PreemptionGuard",
+    "DivergenceSentinel",
+    "TrainingDiverged",
+    "tree_all_finite",
+    "call_with_retries",
+]

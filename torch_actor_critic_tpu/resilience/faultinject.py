@@ -1,0 +1,189 @@
+"""Fault-injection harness: drive real failure modes through a real Trainer.
+
+Recovery code that only runs during real outages is recovery code that
+has never run. This module injects each production fault class into an
+unmodified :class:`~torch_actor_critic_tpu.sac.trainer.Trainer` so
+``tests/test_resilience.py`` can prove every recovery path end-to-end
+on CPU:
+
+- **NaN batches** — :class:`FaultyEnvPool` wraps any env pool and
+  corrupts scheduled step outputs (rewards/observations), exercising
+  the divergence sentinel + rollback path.
+- **Simulated SIGTERM** — :meth:`FaultyEnvPool.call_at` runs an
+  arbitrary callback at an exact pool step (e.g. ``os.kill(os.getpid(),
+  SIGTERM)`` or ``guard.request_preemption()``), exercising the
+  preemption save/requeue path deterministically: everything keys off
+  step counts, never wall-clock sleeps.
+- **Env-worker death** — :func:`kill_env_worker` SIGKILLs a
+  :class:`ParallelEnvPool` worker and *joins* it, so the next pool op
+  deterministically observes a dead (not "maybe-dead") worker.
+- **Checkpoint IO faults** — :func:`make_flaky` wraps any callable to
+  fail its first N calls (transient-IO retry path);
+  :func:`corrupt_checkpoint` damages an on-disk Orbax step the way an
+  interrupted async save does (missing items / truncated arrays),
+  exercising the fallback-to-previous-epoch path.
+
+Injection is deliberately *compositional*: tests build a normal
+Trainer, then ``trainer.pool = FaultyEnvPool(trainer.pool, ...)`` —
+the trainer code under test is exactly the code production runs.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import typing as t
+from pathlib import Path
+
+import numpy as np
+
+__all__ = [
+    "FaultyEnvPool",
+    "kill_env_worker",
+    "make_flaky",
+    "corrupt_checkpoint",
+]
+
+
+class FaultyEnvPool:
+    """Protocol-transparent env-pool wrapper with step-scheduled faults.
+
+    Wraps any object implementing the pool protocol
+    (``envs/vec_env.py``); every attribute not overridden here proxies
+    to the wrapped pool, so the trainer cannot tell the difference.
+    Step numbering counts ``step()`` calls on THIS wrapper, starting
+    at 0 — i.e. lockstep trainer steps.
+    """
+
+    def __init__(self, pool: t.Any):
+        self._pool = pool
+        self._step_count = 0
+        self._before: t.Dict[int, t.List[t.Callable[[], None]]] = {}
+        self._corrupt: t.Dict[int, t.List[t.Callable]] = {}
+
+    # ---------------------------------------------------------- scheduling
+
+    def call_at(self, step: int, fn: t.Callable[[], None]) -> "FaultyEnvPool":
+        """Run ``fn()`` immediately before pool step ``step`` executes."""
+        self._before.setdefault(step, []).append(fn)
+        return self
+
+    def nan_rewards_at(
+        self, step: int, envs: t.Sequence[int] | None = None
+    ) -> "FaultyEnvPool":
+        """Replace the scheduled step's rewards with NaN (all envs by
+        default) — the classic silent-poison fault."""
+
+        def corrupt(obs, rewards, terms, truncs):
+            rewards = np.array(rewards, np.float32)
+            rewards[list(envs) if envs is not None else slice(None)] = np.nan
+            return obs, rewards, terms, truncs
+
+        self._corrupt.setdefault(step, []).append(corrupt)
+        return self
+
+    def nan_obs_at(
+        self, step: int, envs: t.Sequence[int] | None = None
+    ) -> "FaultyEnvPool":
+        """NaN the scheduled step's next-observations (flat leaves)."""
+
+        def corrupt(obs, rewards, terms, truncs):
+            import jax
+
+            rows = list(envs) if envs is not None else None
+
+            def poison(x):
+                x = np.array(x)
+                if np.issubdtype(x.dtype, np.floating):
+                    x[rows if rows is not None else slice(None)] = np.nan
+                return x
+
+            return (
+                jax.tree_util.tree_map(poison, obs), rewards, terms, truncs,
+            )
+
+        self._corrupt.setdefault(step, []).append(corrupt)
+        return self
+
+    # ------------------------------------------------------------ protocol
+
+    def step(self, actions):
+        n = self._step_count
+        self._step_count += 1
+        for fn in self._before.pop(n, []):
+            fn()
+        out = self._pool.step(actions)
+        for corrupt in self._corrupt.pop(n, []):
+            out = corrupt(*out)
+        return out
+
+    def __getattr__(self, name: str):
+        return getattr(self._pool, name)
+
+
+def kill_env_worker(pool, idx: int, join_timeout_s: float = 10.0) -> int:
+    """SIGKILL worker ``idx`` of a :class:`ParallelEnvPool` and reap it.
+
+    Joining before returning makes the death *observable* — the next
+    pool operation deterministically times out and diagnoses a dead
+    worker (with its exit code) instead of racing the kernel. Returns
+    the worker's exit code (``-SIGKILL``).
+    """
+    proc = pool._procs[idx]
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.join(timeout=join_timeout_s)
+    if proc.is_alive():  # pragma: no cover — SIGKILL cannot be blocked
+        raise RuntimeError(f"worker {idx} survived SIGKILL")
+    return proc.exitcode
+
+
+def make_flaky(
+    fn: t.Callable,
+    failures: int,
+    exc_factory: t.Callable[[], BaseException] = lambda: OSError(
+        "injected transient checkpoint IO failure"
+    ),
+) -> t.Callable:
+    """Wrap ``fn`` so its first ``failures`` calls raise, then it
+    delegates — the transient-IO model for the retry path."""
+    state = {"left": failures}
+
+    def wrapper(*args, **kwargs):
+        if state["left"] > 0:
+            state["left"] -= 1
+            raise exc_factory()
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def corrupt_checkpoint(
+    directory: str | Path, epoch: int, mode: str = "drop-item"
+) -> Path:
+    """Damage the on-disk Orbax step for ``epoch`` like a mid-write crash.
+
+    - ``"drop-item"``: remove the ``train_state`` item (an async save
+      interrupted before the arrays landed);
+    - ``"drop-meta"``: remove the ``meta`` JSON item (interrupted even
+      earlier — the step is unreadable at probe time);
+    - ``"truncate"``: zero-truncate every array file under
+      ``train_state`` (partial flush: the structure exists, the bytes
+      do not).
+
+    Returns the corrupted step directory.
+    """
+    step_dir = Path(directory) / str(epoch)
+    if not step_dir.is_dir():
+        raise FileNotFoundError(f"no checkpoint step dir {step_dir}")
+    if mode == "drop-item":
+        shutil.rmtree(step_dir / "train_state")
+    elif mode == "drop-meta":
+        shutil.rmtree(step_dir / "meta")
+    elif mode == "truncate":
+        for f in (step_dir / "train_state").rglob("*"):
+            if f.is_file():
+                f.write_bytes(b"")
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    return step_dir

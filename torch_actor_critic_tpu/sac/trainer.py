@@ -48,6 +48,11 @@ from torch_actor_critic_tpu.parallel import (
 )
 from torch_actor_critic_tpu.parallel.mesh import local_dp_info
 from torch_actor_critic_tpu.parallel.distributed import global_statistics, is_coordinator
+from torch_actor_critic_tpu.resilience.preemption import Preempted, PreemptionGuard
+from torch_actor_critic_tpu.resilience.sentinel import (
+    DivergenceSentinel,
+    TrainingDiverged,
+)
 from torch_actor_critic_tpu.sac.algorithm import SAC
 from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
 from torch_actor_critic_tpu.utils.config import SACConfig
@@ -226,6 +231,7 @@ class Trainer:
         seed: int = 0,
         env_kwargs: dict | None = None,
         render: bool = False,
+        preemption: PreemptionGuard | None = None,
     ):
         import os
         import sys
@@ -295,6 +301,18 @@ class Trainer:
             self.n_envs, self._env_offset = local_dp_info(self.mesh)
         self.tracker = tracker
         self.checkpointer = checkpointer
+        # Resilience (docs/RESILIENCE.md): the divergence sentinel
+        # validates every epoch boundary (and gates every checkpoint,
+        # so "latest checkpoint" is always "last-good"); the preemption
+        # guard, when given, is polled at window/epoch boundaries for
+        # the emergency-save-and-requeue path.
+        self.sentinel = (
+            DivergenceSentinel(max_rollbacks=self.config.max_rollbacks)
+            if self.config.sentinel
+            else None
+        )
+        self.preemption = preemption
+        self._resume_step: int | None = None
 
         # One env per dp mesh slice, stepped as a pool: sequential
         # in-process by default, parallel worker processes over the
@@ -518,16 +536,116 @@ class Trainer:
             done=stack_field(4).astype(np.float32),
         )
 
+    # --------------------------------------------------------- resilience
+
+    def _epoch_seed(self, epoch: int, i: int) -> int:
+        """Env seed for slice ``i`` at the start of ``epoch`` — a pure
+        function of (run seed, epoch, global slice), so epochs are
+        replayable units: a resumed run reseeds its fresh envs exactly
+        as the uninterrupted run reseeded its live ones at the same
+        boundary (docs/RESILIENCE.md). At epoch 0 this reduces to the
+        historical ``seed + 10000 * slice`` scheme."""
+        return (
+            self.seed
+            + 1_000_003 * epoch
+            + 10_000 * (self._env_offset + i)
+        )
+
+    def _save_checkpoint(self, epoch: int, step: int, wait: bool = False):
+        """One checkpoint = TrainState + buffer + the host-loop state a
+        TrainState cannot carry: the lockstep step counter (warmup and
+        update-gate thresholds continue, instead of re-randomizing
+        ``start_steps`` actions on every resume) and the acting PRNG
+        key (the exploration stream continues bitwise)."""
+        self.checkpointer.save(
+            epoch,
+            self.state,
+            self.buffer,
+            extra={
+                "config": self.config.to_json(),
+                "normalizer": self.normalizer.state_dict(),
+                "step": int(step),
+                "act_key": np.asarray(
+                    jax.random.key_data(self._act_key)
+                ).astype(np.uint32).tolist(),
+            },
+            wait=wait,
+        )
+
+    def _load_checkpoint(
+        self, epoch: int | None = None, include_buffer: bool = True
+    ) -> dict:
+        """Restore trainer state in place from the checkpointer; shared
+        by :meth:`restore` (resume) and :meth:`_rollback` (divergence
+        recovery). Returns the checkpoint metadata."""
+        # Validate the algorithm family from metadata BEFORE the array
+        # restore: a TD3 state has a target-actor subtree a SAC trainer
+        # lacks (and vice versa), which would otherwise surface as an
+        # opaque Orbax tree-structure error. The probe is reused by the
+        # restore below (no second metadata round-trip).
+        meta_probe = self.checkpointer.peek_meta(epoch)
+        if meta_probe.get("config"):
+            saved_algo = SACConfig.from_json(meta_probe["config"]).algorithm
+            if saved_algo != self.config.algorithm:
+                raise ValueError(
+                    f"checkpoint was written by algorithm={saved_algo!r} "
+                    f"but this trainer is configured for "
+                    f"{self.config.algorithm!r}; pass --algorithm "
+                    f"{saved_algo} to resume it"
+                )
+        state, buffer, meta = self.checkpointer.restore(
+            jax.tree_util.tree_map(lambda x: x, self.state),
+            self.buffer if include_buffer else None,
+            epoch=epoch,
+            meta_probe=meta_probe,
+        )
+        self.state = state
+        self._host_params = None  # mirror is stale
+        if buffer is not None:
+            self.buffer = buffer
+        if "normalizer" in meta and meta["normalizer"]:
+            self.normalizer.load_state_dict(meta["normalizer"])
+        if meta.get("act_key"):
+            key = jax.random.wrap_key_data(
+                jnp.asarray(np.asarray(meta["act_key"], dtype=np.uint32))
+            )
+            if self.config.host_actor:
+                key = jax.device_put(key, self._host_device)
+            self._act_key = key
+        return meta
+
+    def _rollback(self) -> int:
+        """Divergence recovery: restore the newest (sentinel-validated)
+        checkpoint and report its epoch. Checkpoints are only ever
+        written after the sentinel passes, so the newest one is by
+        construction the last-good state — params, optimizer moments
+        AND the replay ring (a poisoned ring would re-diverge on the
+        next unlucky sample)."""
+        if self.checkpointer is None or self.checkpointer.latest_epoch() is None:
+            raise TrainingDiverged(
+                "training state is non-finite and there is no checkpoint "
+                "to roll back to (no checkpointer configured, or "
+                "divergence before the first save)"
+            )
+        meta = self._load_checkpoint(epoch=None, include_buffer=True)
+        return int(meta["epoch"])
+
     # -------------------------------------------------------------- train
 
     def train(self, render: bool = False) -> dict:
         cfg = self.config
         n = self.n_envs
 
+        # Epoch-boundary seeds (resilience): a resumed run's fresh envs
+        # reset exactly as the uninterrupted run's live envs were
+        # reseeded at the same epoch boundary. epoch_reseed=False keeps
+        # the historical flat scheme (epoch term zero).
         obs = self._normalize(
             self.pool.reset_all(
                 [
-                    self.seed + 10000 * (self._env_offset + i)
+                    self._epoch_seed(
+                        self.start_epoch if cfg.epoch_reseed else 0, i
+                    )
                     for i in range(n)
                 ]
             ),
@@ -544,7 +662,15 @@ class Trainer:
         # volume scales with dp exactly as the reference's scales with
         # worker count (1000 warmup steps × N ranks there, × n_envs
         # here). Documented in PARITY.md §counters.
-        step = 0
+        # A resumed run CONTINUES the counter (checkpoint meta carries
+        # it) instead of restarting at 0 — restarting would re-randomize
+        # start_steps actions and re-gate update_after on every resume,
+        # making each preemption cost a full warmup.
+        step = (
+            self._resume_step
+            if self._resume_step is not None
+            else self.start_epoch * cfg.steps_per_epoch
+        )
         last_metrics: dict = {}
         episode_rewards: list = []
         episode_lengths: list = []
@@ -616,10 +742,23 @@ class Trainer:
                         episode_lengths.append(int(ep_len[i]))
                         if self.population > 1:
                             member_rewards[i].append(float(ep_ret[i]))
+                        # Epoch-boundary resets are SEEDED (pure
+                        # function of seed/epoch/slice) so epochs are
+                        # replayable after a preemption resume;
+                        # mid-epoch episode ends keep the env's own
+                        # stream, which that seed determines.
+                        reset_seed = (
+                            self._epoch_seed(e + 1, i)
+                            if epoch_ended and cfg.epoch_reseed
+                            else None
+                        )
                         _set_row(
                             next_obs,
                             i,
-                            self._normalize(self.pool.reset_at(i), update=True),
+                            self._normalize(
+                                self.pool.reset_at(i, seed=reset_seed),
+                                update=True,
+                            ),
                         )
                     ep_ret[ended] = 0.0
                     ep_len[ended] = 0
@@ -667,6 +806,25 @@ class Trainer:
                         self.buffer = self.dp.push_chunk(self.buffer, chunk)
 
                 step += 1
+
+                # Urgent preemption (repeated SIGTERM): the window
+                # boundary is the safe step boundary — staging just
+                # flushed, the burst dispatched — so checkpoint NOW and
+                # unwind. The learner state is lossless; only this
+                # epoch's un-stepped env tail is skipped on resume
+                # (docs/RESILIENCE.md).
+                if (
+                    window_full
+                    and self.preemption is not None
+                    and self.preemption.urgent
+                ):
+                    if self.checkpointer is not None:
+                        if losses_q:
+                            drain(losses_q[-1])
+                        else:
+                            drain(self.buffer.size)
+                        self._save_checkpoint(e, step, wait=True)
+                    raise Preempted(epoch=e, urgent=True)
 
             # --- end of epoch: metrics + checkpoint (ref :285-296) ---
             # Drain queued device work BEFORE taking the epoch time (see
@@ -718,6 +876,35 @@ class Trainer:
                             np.mean(member_rewards[i])
                         )
                 member_rewards = [[] for _ in range(n)]
+            # --- divergence sentinel (resilience/sentinel.py): one
+            # fused all-finite pass over learner state + replay ring +
+            # this epoch's losses, BEFORE anything is checkpointed — so
+            # every checkpoint on disk is sentinel-validated and
+            # "latest" is always "last-good" for the rollback path. The
+            # ring is included because a NaN transition outlives the
+            # step that produced it (it sits in replay waiting to be
+            # sampled); a params-only rollback would re-diverge.
+            sentinel_ok = True
+            if self.sentinel is not None:
+                sentinel_ok = self.sentinel.check(
+                    self.state, self.buffer.data, losses_q, losses_pi
+                )
+                if not sentinel_ok:
+                    # Budget first: raises TrainingDiverged once the
+                    # consecutive-rollback allowance is exhausted.
+                    self.sentinel.note_divergence(f"state at epoch {e}")
+                    rolled_to = self._rollback()
+                    logger.warning(
+                        "epoch %d: non-finite training state detected; "
+                        "rolled back to checkpoint epoch %d (rollback "
+                        "%d, %d consecutive) — skipping save, resuming",
+                        e, rolled_to, self.sentinel.total_rollbacks,
+                        self.sentinel.consecutive,
+                    )
+                else:
+                    self.sentinel.note_good()
+                last_metrics["rollbacks"] = self.sentinel.total_rollbacks
+
             if is_coordinator() and self.tracker is not None:
                 self.tracker.log_metrics(last_metrics, e)
             # Orbax saves of sharded arrays are collective: EVERY process
@@ -725,17 +912,33 @@ class Trainer:
             # buffer); rank-gating applies only to metric logging.
             # The final epoch always saves, so short runs (< save_every
             # epochs) still produce a checkpoint run_agent can load.
-            if self.checkpointer is not None and (
-                e % cfg.save_every == 0
-                or e == self.start_epoch + cfg.epochs - 1
-            ):
-                self.checkpointer.save(
-                    e,
-                    self.state,
-                    self.buffer,
-                    extra={"config": self.config.to_json(),
-                           "normalizer": self.normalizer.state_dict()},
+            saved_this_epoch = False
+            if (
+                sentinel_ok
+                and self.checkpointer is not None
+                and (
+                    e % cfg.save_every == 0
+                    or e == self.start_epoch + cfg.epochs - 1
                 )
+            ):
+                self._save_checkpoint(e, step)
+                saved_this_epoch = True
+
+            # --- graceful preemption (single SIGTERM/SIGINT): the
+            # epoch is complete and, if it passed the sentinel,
+            # checkpointed — the lossless exit point. The save is
+            # synchronous: this process is about to die.
+            if self.preemption is not None and self.preemption.triggered:
+                if (
+                    sentinel_ok
+                    and self.checkpointer is not None
+                    and not saved_this_epoch
+                ):
+                    self._save_checkpoint(e, step)
+                if self.checkpointer is not None:
+                    self.checkpointer.wait()
+                raise Preempted(epoch=e)
+
             if hasattr(epoch_iter, "set_postfix"):
                 epoch_iter.set_postfix({**last_metrics, "step": step})
 
@@ -763,34 +966,14 @@ class Trainer:
         mesh)."""
         if self.checkpointer is None:
             raise ValueError("no checkpointer configured")
-        # Validate the algorithm family from metadata BEFORE the array
-        # restore: a TD3 state has a target-actor subtree a SAC trainer
-        # lacks (and vice versa), which would otherwise surface as an
-        # opaque Orbax tree-structure error. The probe is reused by the
-        # restore below (no second metadata round-trip).
-        meta_probe = self.checkpointer.peek_meta(epoch)
-        if meta_probe.get("config"):
-            saved_algo = SACConfig.from_json(meta_probe["config"]).algorithm
-            if saved_algo != self.config.algorithm:
-                raise ValueError(
-                    f"checkpoint was written by algorithm={saved_algo!r} "
-                    f"but this trainer is configured for "
-                    f"{self.config.algorithm!r}; pass --algorithm "
-                    f"{saved_algo} to resume it"
-                )
-        state, buffer, meta = self.checkpointer.restore(
-            jax.tree_util.tree_map(lambda x: x, self.state),
-            self.buffer if include_buffer else None,
-            epoch=epoch,
-            meta_probe=meta_probe,
-        )
-        self.state = state
-        self._host_params = None  # mirror is stale
-        if buffer is not None:
-            self.buffer = buffer
-        if "normalizer" in meta and meta["normalizer"]:
-            self.normalizer.load_state_dict(meta["normalizer"])
+        meta = self._load_checkpoint(epoch, include_buffer)
         self.start_epoch = int(meta["epoch"]) + 1
+        # Pre-resilience checkpoints carry no step counter; fall back
+        # to the epoch-aligned count (exact when the save was an epoch
+        # boundary, which every non-urgent save is).
+        self._resume_step = int(
+            meta.get("step", self.start_epoch * self.config.steps_per_epoch)
+        )
         return self.start_epoch
 
     # --------------------------------------------------------------- eval

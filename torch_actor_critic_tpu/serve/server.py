@@ -25,6 +25,7 @@ import json
 import logging
 import threading
 import typing as t
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
@@ -100,8 +101,17 @@ class PolicyServer:
         max_wait_ms: float = 2.0,
         metrics: ServeMetrics | None = None,
         seed: int = 0,
+        request_timeout_s: float = 30.0,
+        act_timeout_s: float = 30.0,
     ):
         self.registry = registry
+        # Per-connection socket timeout + bounded wait on the batcher
+        # future: without these one stalled client (or a wedged engine)
+        # pins a ThreadingHTTPServer handler thread FOREVER — the
+        # stdlib default is no timeout at all — and a few thousand such
+        # clients exhaust the thread pool, i.e. a trivial slow-loris.
+        self.request_timeout_s = float(request_timeout_s)
+        self.act_timeout_s = float(act_timeout_s)
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.batcher = MicroBatcher(
             registry, max_batch=max_batch, max_wait_ms=max_wait_ms,
@@ -111,16 +121,30 @@ class PolicyServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # Socket timeout for the whole connection (stdlib applies
+            # the class attribute via connection.settimeout in setup();
+            # handle_one_request maps the timeout to close_connection),
+            # so a client that stops sending mid-request releases its
+            # handler thread instead of wedging it forever.
+            timeout = server.request_timeout_s
+
             # Keep the stdlib's per-request stderr lines out of the
             # serving hot path; route to logging at debug level.
             def log_message(self, fmt, *args):  # noqa: A003
                 logger.debug("http: " + fmt, *args)
 
-            def _send(self, code: int, payload: dict):
+            def _send(
+                self,
+                code: int,
+                payload: dict,
+                headers: dict | None = None,
+            ):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -169,7 +193,22 @@ class PolicyServer:
                         obs,
                         deterministic=bool(body.get("deterministic", True)),
                         slot=slot,
+                        timeout=server.act_timeout_s,
                     )
+                except FutureTimeoutError:
+                    # Batcher overload/stall is transient, not a server
+                    # bug: 503 + Retry-After tells well-behaved clients
+                    # (and load balancers) to back off and retry, where
+                    # a generic 500 reads as "broken, page someone".
+                    self._send(
+                        503,
+                        {
+                            "error": "policy backend timed out; retry",
+                            "timeout_s": server.act_timeout_s,
+                        },
+                        headers={"Retry-After": "1"},
+                    )
+                    return
                 except (ValueError, TypeError) as e:
                     self._send(400, {"error": str(e)})
                     return

@@ -27,6 +27,11 @@ from torch_actor_critic_tpu.parallel.distributed import (
     initialize_multihost,
     is_coordinator,
 )
+from torch_actor_critic_tpu.resilience.preemption import (
+    REQUEUE_EXIT_CODE,
+    Preempted,
+    PreemptionGuard,
+)
 from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
 from torch_actor_critic_tpu.utils.config import SACConfig
 from torch_actor_critic_tpu.utils.tracking import Tracker
@@ -72,6 +77,14 @@ def parse_arguments(argv=None) -> argparse.Namespace:
         dest="save_buffer",
         action="store_false",
         help="Exclude the replay buffer from checkpoints",
+    )
+    parser.add_argument(
+        "--no-preemption-guard",
+        dest="preemption_guard",
+        action="store_false",
+        help="Do not install SIGTERM/SIGINT handlers (default: on — a "
+        "signal triggers an emergency checkpoint and exit with the "
+        "requeue code %d; see docs/RESILIENCE.md)" % REQUEUE_EXIT_CODE,
     )
     # Every SACConfig field becomes a flag (--batch-size, --learn-alpha, ...).
     for f in dataclasses.fields(SACConfig):
@@ -152,6 +165,12 @@ def main(argv=None):
         )
         logger.info("final metrics: %s", metrics)
         return metrics
+    # Preemption guard (resilience/, docs/RESILIENCE.md): one SIGTERM/
+    # SIGINT finishes the epoch, checkpoints, and exits with the
+    # requeue code so `make`/schedulers restart with `--run <id>` for a
+    # lossless resume; a second signal saves at the next update-window
+    # boundary instead.
+    guard = PreemptionGuard().install() if args.preemption_guard else None
     trainer = Trainer(
         env_name,
         config,
@@ -160,6 +179,7 @@ def main(argv=None):
         checkpointer=checkpointer,
         seed=args.seed,
         render=args.render,
+        preemption=guard,
     )
     if args.run is not None and checkpointer.latest_epoch() is not None:
         start = trainer.restore()
@@ -177,8 +197,17 @@ def main(argv=None):
             logger.info("profiler trace written to %s", args.profile)
         else:
             metrics = trainer.train(render=args.render)
+    except Preempted as p:
+        logger.warning(
+            "%s — resume with: python -m torch_actor_critic_tpu.train "
+            "--run %s --runs-root %s",
+            p, tracker.run_id, args.runs_root,
+        )
+        raise SystemExit(p.exit_code)
     finally:
         trainer.close()
+        if guard is not None:
+            guard.uninstall()
     logger.info("final metrics: %s", metrics)
     return metrics
 

@@ -17,6 +17,8 @@ a multi-chip run resumes onto the same mesh layout.
 
 from __future__ import annotations
 
+import logging
+import time
 import typing as t
 from pathlib import Path
 
@@ -24,6 +26,9 @@ import jax
 import orbax.checkpoint as ocp
 
 from torch_actor_critic_tpu.core.types import BufferState, TrainState
+from torch_actor_critic_tpu.resilience.retry import call_with_retries
+
+logger = logging.getLogger(__name__)
 
 # Checkpoint format version, bumped on any param-tree layout change.
 # 2: Dense submodules are named by their tensor-parallel role
@@ -84,20 +89,46 @@ def _has_unrolled_visual_ensemble(train_state: TrainState) -> bool:
     )
 
 
+class CheckpointFormatError(ValueError):
+    """The checkpoint's param-tree layout predates this build (see
+    ``CKPT_FORMAT``). Deliberately NOT retried/fallen-back-from: every
+    epoch in the directory shares the writer's format, so walking to an
+    older step cannot fix it."""
+
+
 class Checkpointer:
     def __init__(
         self,
         directory: str | Path,
         max_to_keep: int = 3,
         save_buffer: bool = True,
+        retries: int = 2,
+        retry_backoff_s: float = 0.5,
+        sleep: t.Callable[[float], None] = time.sleep,
     ):
         self.directory = Path(directory).absolute()
         self.save_buffer = save_buffer
+        # Transient-IO policy (resilience/retry.py): every Orbax
+        # save/restore call gets `retries` extra attempts with
+        # exponential backoff before the error surfaces. `sleep` is
+        # injectable so tests drive the ladder without real waiting.
+        self._retries = int(retries)
+        self._retry_backoff_s = float(retry_backoff_s)
+        self._sleep = sleep
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True
             ),
+        )
+
+    def _retry(self, fn: t.Callable[[], t.Any], what: str):
+        return call_with_retries(
+            fn,
+            attempts=self._retries + 1,
+            base_delay_s=self._retry_backoff_s,
+            sleep=self._sleep,
+            what=what,
         )
 
     def save(
@@ -117,26 +148,64 @@ class Checkpointer:
         }
         if buffer_state is not None and self.save_buffer:
             items["buffer"] = ocp.args.StandardSave(buffer_state)
-        self._mgr.save(epoch, args=ocp.args.Composite(**items))
+        self._retry(
+            lambda: self._mgr.save(epoch, args=ocp.args.Composite(**items)),
+            what=f"checkpoint save (epoch {epoch})",
+        )
         if wait:
-            self._mgr.wait_until_finished()
+            self._retry(
+                self._mgr.wait_until_finished,
+                what=f"checkpoint save finalize (epoch {epoch})",
+            )
 
     def latest_epoch(self) -> int | None:
-        return self._mgr.latest_step()
+        """Newest *readable* checkpoint step.
+
+        An interrupted async save (preemption mid-write, full disk) can
+        leave a step directory whose metadata never landed; treating it
+        as "latest" would kill every subsequent resume. Steps whose
+        metadata cannot be read are skipped (with a warning) in favor
+        of the newest valid epoch — exactly what resume wants.
+        """
+        for step in self._valid_candidates():
+            return step
+        return None
+
+    def _valid_candidates(self) -> t.Iterator[int]:
+        """All steps newest-first whose JSON metadata is readable."""
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            try:
+                self._peek_meta_at(step)
+            except Exception as e:  # noqa: BLE001 — any unreadable step
+                # is a skip, whatever Orbax raises for it
+                logger.warning(
+                    "checkpoint epoch %s under %s is unreadable (%s: %s); "
+                    "skipping it",
+                    step, self.directory, type(e).__name__, e,
+                )
+                continue
+            yield step
+
+    def _peek_meta_at(self, epoch: int) -> dict:
+        return dict(
+            self._retry(
+                lambda: self._mgr.restore(
+                    epoch,
+                    args=ocp.args.Composite(meta=ocp.args.JsonRestore()),
+                ),
+                what=f"checkpoint metadata read (epoch {epoch})",
+            )["meta"]
+        )
 
     def peek_meta(self, epoch: int | None = None) -> dict:
         """The checkpoint's JSON metadata alone (no array restore) —
         lets callers validate compatibility (e.g. which algorithm wrote
         it) BEFORE a tree-structure mismatch surfaces as an opaque
-        Orbax error."""
-        epoch = epoch if epoch is not None else self._mgr.latest_step()
+        Orbax error. ``epoch=None`` reads the newest *valid* epoch."""
+        epoch = epoch if epoch is not None else self.latest_epoch()
         if epoch is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
-        return dict(
-            self._mgr.restore(
-                epoch, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
-            )["meta"]
-        )
+        return self._peek_meta_at(epoch)
 
     def restore(
         self,
@@ -153,15 +222,57 @@ class Checkpointer:
         :meth:`peek_meta` (for its own compatibility checks) can pass
         the result as ``meta_probe`` to skip the redundant metadata
         round-trip.
+
+        With ``epoch=None`` (resume), a corrupt or partial newest step
+        — interrupted async save, truncated arrays — falls back to the
+        next older epoch instead of killing the resume: losing one
+        ``save_every`` interval beats losing the run. An explicitly
+        requested ``epoch`` never falls back (the caller asked for that
+        state, substituting another would be silent corruption).
         """
-        epoch = epoch if epoch is not None else self._mgr.latest_step()
-        if epoch is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if epoch is not None:
+            return self._restore_at(
+                epoch, abstract_train_state, abstract_buffer, meta_probe
+            )
+        last_err: Exception | None = None
+        tried = 0
+        for step in self._valid_candidates():
+            try:
+                return self._restore_at(
+                    step,
+                    abstract_train_state,
+                    abstract_buffer,
+                    # The probe the caller took describes the newest
+                    # valid epoch only; older fallback epochs re-probe.
+                    meta_probe if tried == 0 else None,
+                )
+            except CheckpointFormatError:
+                raise  # every epoch shares the writer's format
+            except Exception as e:  # noqa: BLE001 — corrupt step: any
+                # Orbax error class means "this epoch is unusable"
+                logger.warning(
+                    "checkpoint epoch %d under %s failed to restore "
+                    "(%s: %s); falling back to the previous epoch",
+                    step, self.directory, type(e).__name__, e,
+                )
+                last_err = e
+                tried += 1
+        if last_err is not None:
+            raise last_err
+        raise FileNotFoundError(f"no checkpoints under {self.directory}")
+
+    def _restore_at(
+        self,
+        epoch: int,
+        abstract_train_state: TrainState,
+        abstract_buffer: BufferState | None,
+        meta_probe: dict | None,
+    ) -> t.Tuple[TrainState, BufferState | None, dict]:
         # Check the format version BEFORE the array restore, so a layout
         # change surfaces as this message instead of an opaque Orbax
         # tree-structure mismatch.
         if meta_probe is None:
-            meta_probe = self.peek_meta(epoch)
+            meta_probe = self._peek_meta_at(epoch)
         found = int(meta_probe.get("ckpt_format", 1))
         if found != CKPT_FORMAT and not (
             found == 2 and not _has_unrolled_visual_ensemble(abstract_train_state)
@@ -170,7 +281,7 @@ class Checkpointer:
             # unroll); format-2 checkpoints of every other family
             # (flat MLP, TD3, sequence) restore unchanged — rejecting
             # them would invalidate working checkpoints for no reason.
-            raise ValueError(
+            raise CheckpointFormatError(
                 f"checkpoint at {self.directory} epoch {epoch} has format "
                 f"{found}, this build reads format {CKPT_FORMAT}: the model "
                 "parameter tree layout changed (see CKPT_FORMAT in "
@@ -203,7 +314,12 @@ class Checkpointer:
             absl_logger.setLevel(prev_level)
         if abstract_buffer is not None and "buffer" in saved_items:
             items["buffer"] = ocp.args.StandardRestore(abstract_buffer)
-        out = self._mgr.restore(epoch, args=ocp.args.Composite(**items))
+        out = self._retry(
+            lambda: self._mgr.restore(
+                epoch, args=ocp.args.Composite(**items)
+            ),
+            what=f"checkpoint restore (epoch {epoch})",
+        )
         train_state = _rewrap_prng_keys(
             out["train_state"], abstract_train_state
         )
@@ -222,9 +338,25 @@ class Checkpointer:
         MB and tens of GB) and the actor subtree extracted. Params come
         back as a plain nested dict, which is exactly what
         ``actor_def.apply`` takes.
+
+        As with :meth:`restore`, ``epoch=None`` falls back past corrupt
+        newest steps (a serving replica must come up on the last good
+        weights, not crash-loop on a half-written save).
         """
-        epoch = epoch if epoch is not None else self._mgr.latest_step()
         if epoch is None:
+            last_err: Exception | None = None
+            for step in self._valid_candidates():
+                try:
+                    return self.restore_actor_params(step)
+                except Exception as e:  # noqa: BLE001 — corrupt step
+                    logger.warning(
+                        "actor restore from epoch %d under %s failed "
+                        "(%s: %s); falling back to the previous epoch",
+                        step, self.directory, type(e).__name__, e,
+                    )
+                    last_err = e
+            if last_err is not None:
+                raise last_err
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
         # The shape-from-disk restore makes Orbax warn that a target
         # tree "is generally UNSAFE" — for serving the disk layout IS
@@ -236,12 +368,15 @@ class Checkpointer:
         prev_level = absl_logger.level
         absl_logger.setLevel(_logging.ERROR)
         try:
-            out = self._mgr.restore(
-                epoch,
-                args=ocp.args.Composite(
-                    train_state=ocp.args.StandardRestore(),
-                    meta=ocp.args.JsonRestore(),
+            out = self._retry(
+                lambda: self._mgr.restore(
+                    epoch,
+                    args=ocp.args.Composite(
+                        train_state=ocp.args.StandardRestore(),
+                        meta=ocp.args.JsonRestore(),
+                    ),
                 ),
+                what=f"actor restore (epoch {epoch})",
             )
         finally:
             absl_logger.setLevel(prev_level)

@@ -168,6 +168,25 @@ class SACConfig:
     # pure numpy).
     env_start_method: str = "spawn"
 
+    # --- resilience (resilience/, docs/RESILIENCE.md) ---
+    # Divergence sentinel: one fused all-finite check over the learner
+    # state + replay ring + epoch losses at every epoch boundary; a
+    # non-finite epoch rolls back to the last sentinel-validated
+    # checkpoint instead of poisoning the run (the reference trains on
+    # NaNs forever). max_rollbacks bounds CONSECUTIVE rollbacks before
+    # aborting with TrainingDiverged — a streak means the fault is
+    # systematic, not transient.
+    sentinel: bool = True
+    max_rollbacks: int = 3
+    # Reseed every env at each epoch boundary with a seed derived from
+    # (run seed, epoch, slice). Epochs become replayable units — the
+    # property that makes preemption resume bitwise-identical to an
+    # uninterrupted run (envs carry no state across the checkpoint
+    # boundary). False restores pre-resilience behavior: epoch-boundary
+    # resets continue each env's internal RNG stream, so a resumed run
+    # sees different env realizations than the run it resumes.
+    epoch_reseed: bool = True
+
     def __post_init__(self):
         if not (len(self.filters) == len(self.kernel_sizes) == len(self.strides)):
             raise ValueError(
@@ -232,6 +251,10 @@ class SACConfig:
                 "the 'independent' seeds through their input scaling; "
                 "per-member normalizers are not wired yet — run the "
                 "population unnormalized"
+            )
+        if self.max_rollbacks < 0:
+            raise ValueError(
+                f"max_rollbacks must be >= 0, got {self.max_rollbacks}"
             )
         if self.actor_param_lag and not self.host_actor:
             raise ValueError(
