@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke
+.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -45,6 +45,12 @@ serve:
 # /healthz round-trip; exits nonzero on failure.
 serve-smoke:
 	JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+
+# Observability smoke: tiny CPU run with telemetry + a --profile-epochs
+# window; asserts the JSONL event stream, the XLA trace artifacts and
+# the phase-coverage contract (docs/OBSERVABILITY.md).
+trace-smoke:
+	JAX_PLATFORMS=cpu python scripts/trace_smoke.py
 
 # Fault-injection suite: every recovery path (NaN rollback, SIGTERM
 # save+requeue+bitwise resume, checkpoint retry/fallback, dead env

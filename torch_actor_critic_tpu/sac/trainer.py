@@ -54,6 +54,7 @@ from torch_actor_critic_tpu.resilience.sentinel import (
     TrainingDiverged,
 )
 from torch_actor_critic_tpu.sac.algorithm import SAC
+from torch_actor_critic_tpu.telemetry import TelemetryRecorder
 from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
 from torch_actor_critic_tpu.utils.config import SACConfig
 from torch_actor_critic_tpu.utils.normalize import (
@@ -65,6 +66,20 @@ from torch_actor_critic_tpu.utils.sync import drain
 from torch_actor_critic_tpu.utils.tracking import Tracker
 
 logger = logging.getLogger(__name__)
+
+# Integer indices into telemetry.PHASES, hoisted to module constants so
+# the hot loop's instrumentation is `rec.lap(_PH_ACT)` — no dict or
+# attribute lookups per phase mark (docs/OBSERVABILITY.md).
+(
+    _PH_ACT,
+    _PH_ENV,
+    _PH_STAGE,
+    _PH_PLACE,
+    _PH_BURST,
+    _PH_DRAIN,
+    _PH_SENTINEL,
+    _PH_CKPT,
+) = range(8)
 
 
 def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
@@ -232,6 +247,7 @@ class Trainer:
         env_kwargs: dict | None = None,
         render: bool = False,
         preemption: PreemptionGuard | None = None,
+        telemetry: TelemetryRecorder | None = None,
     ):
         import os
         import sys
@@ -313,6 +329,22 @@ class Trainer:
         )
         self.preemption = preemption
         self._resume_step: int | None = None
+        # Observability (telemetry/, docs/OBSERVABILITY.md): phase spans,
+        # HBM watermarks and a JSONL event stream. None when disabled —
+        # every hot-path instrumentation site is then a single
+        # `rec is not None` pointer check, and the metrics dict is
+        # byte-identical to an uninstrumented build.
+        if telemetry is None and self.config.telemetry:
+            telemetry = TelemetryRecorder(
+                run_dir=(
+                    tracker.run_dir
+                    if tracker is not None
+                    and getattr(tracker, "enabled", False)
+                    and is_coordinator()
+                    else None
+                )
+            )
+        self.telemetry = telemetry
 
         # One env per dp mesh slice, stepped as a pool: sequential
         # in-process by default, parallel worker processes over the
@@ -635,6 +667,9 @@ class Trainer:
     def train(self, render: bool = False) -> dict:
         cfg = self.config
         n = self.n_envs
+        # Loop-local alias: the telemetry checks below compile to one
+        # predicted `is not None` branch per phase mark when disabled.
+        rec = self.telemetry
 
         # Epoch-boundary seeds (resilience): a resumed run's fresh envs
         # reset exactly as the uninterrupted run's live envs were
@@ -692,6 +727,8 @@ class Trainer:
 
         t_epoch = time.time()
         for e in epoch_iter:
+            if rec is not None:
+                rec.epoch_begin(e)
             losses_q, losses_pi = [], []
             env_steps_this_epoch = 0
 
@@ -701,6 +738,8 @@ class Trainer:
                     actions = self.pool.sample_actions()
                 else:
                     actions = self._policy_actions(obs)
+                if rec is not None:
+                    rec.lap(_PH_ACT)
 
                 # --- env step (one lockstep pool dispatch) + bookkeeping
                 # (ref :238-260), batch numpy ops across envs — no
@@ -764,11 +803,15 @@ class Trainer:
                     ep_len[ended] = 0
                 obs = next_obs
                 env_steps_this_epoch += n
+                if rec is not None:
+                    rec.lap(_PH_ENV)
 
                 # --- device window: push or push+update (ref :273-283) ---
                 window_full = (step + 1) % cfg.update_every == 0
                 if window_full:
                     local_chunk = self._build_chunk(staging)
+                    if rec is not None:
+                        rec.lap(_PH_STAGE)
                     if self.population > 1:
                         # Leading axis is the member axis; the learner
                         # shards it over dp itself (no mesh resharding).
@@ -778,6 +821,8 @@ class Trainer:
                             local_chunk, self.mesh, sp=self.dp.effective_sp,
                         )
                     staging = []
+                    if rec is not None:
+                        rec.lap(_PH_PLACE)
                     if step > cfg.update_after:
                         # (config validation guarantees host_actor here)
                         if cfg.actor_param_lag and step + 1 >= cfg.start_steps:
@@ -792,10 +837,23 @@ class Trainer:
                             self._host_params = (
                                 self._fetch_params_single_transfer()
                             )
-                        self.state, self.buffer, m = self.dp.update_burst(
-                            self.state, self.buffer, chunk,
-                            cfg.updates_per_window,
-                        )
+                        if rec is None:
+                            self.state, self.buffer, m = self.dp.update_burst(
+                                self.state, self.buffer, chunk,
+                                cfg.updates_per_window,
+                            )
+                        else:
+                            # Named XLA-trace span: the burst dispatch
+                            # shows up labeled in a --profile-epochs
+                            # capture (the device-side execution it
+                            # queues surfaces under `drain`).
+                            with rec.annotate("train/update_burst"):
+                                self.state, self.buffer, m = (
+                                    self.dp.update_burst(
+                                        self.state, self.buffer, chunk,
+                                        cfg.updates_per_window,
+                                    )
+                                )
                         if not cfg.actor_param_lag:
                             self._host_params = None  # mirror is stale
                         # Keep device scalars; materialize at epoch end
@@ -804,6 +862,8 @@ class Trainer:
                         losses_pi.append(m["loss_pi"])
                     else:
                         self.buffer = self.dp.push_chunk(self.buffer, chunk)
+                    if rec is not None:
+                        rec.lap(_PH_BURST)
 
                 step += 1
 
@@ -824,6 +884,8 @@ class Trainer:
                         else:
                             drain(self.buffer.size)
                         self._save_checkpoint(e, step, wait=True)
+                    if rec is not None:
+                        rec.event("preempted", epoch=e, urgent=True)
                     raise Preempted(epoch=e, urgent=True)
 
             # --- end of epoch: metrics + checkpoint (ref :285-296) ---
@@ -840,8 +902,13 @@ class Trainer:
                 drain(losses_q[-1])
             else:
                 drain(self.buffer.size)
+            # dt covers the epoch's training work only (loop + drain):
+            # t_epoch restarts at the END of the loop body, after the
+            # sentinel check and checkpoint save, which report their own
+            # sentinel_s/save_s metrics instead of silently deflating
+            # the NEXT epoch's env_steps_per_sec/grad_steps_per_sec (the
+            # pre-telemetry accounting bug).
             dt = time.time() - t_epoch
-            t_epoch = time.time()
             # Multi-host: fold every host's observation statistics into
             # the shared global estimate (no-op single-process) so the
             # replicated networks see identically-normalized inputs on
@@ -853,6 +920,10 @@ class Trainer:
             # per-step barrier we deliberately hoist off the hot loop).
             ep_ret_stats = global_statistics(episode_rewards)
             ep_len_stats = global_statistics(episode_lengths)
+            grad_steps_this_epoch = (
+                len(losses_q) * cfg.updates_per_window
+                * max(self.population, 1)
+            )
             last_metrics = {
                 "episode_length": ep_len_stats["mean"],
                 "reward": ep_ret_stats["mean"],
@@ -863,11 +934,12 @@ class Trainer:
                 "loss_q": float(jnp.mean(jnp.stack(losses_q))) if losses_q else 0.0,
                 "loss_pi": float(jnp.mean(jnp.stack(losses_pi))) if losses_pi else 0.0,
                 "env_steps_per_sec": env_steps_this_epoch / dt,
-                "grad_steps_per_sec": (
-                    len(losses_q) * cfg.updates_per_window
-                    * max(self.population, 1)
-                ) / dt,
+                "grad_steps_per_sec": grad_steps_this_epoch / dt,
             }
+            # The loss materialization above is a device fetch: charge
+            # it (plus the drain) to the `drain` phase.
+            if rec is not None:
+                rec.lap(_PH_DRAIN)
             if self.population > 1:
                 # Per-member epoch-mean returns: the N learning curves.
                 for i in range(n):
@@ -884,6 +956,7 @@ class Trainer:
             # ring is included because a NaN transition outlives the
             # step that produced it (it sits in replay waiting to be
             # sampled); a params-only rollback would re-diverge.
+            t_sentinel = time.perf_counter()
             sentinel_ok = True
             if self.sentinel is not None:
                 sentinel_ok = self.sentinel.check(
@@ -901,18 +974,26 @@ class Trainer:
                         e, rolled_to, self.sentinel.total_rollbacks,
                         self.sentinel.consecutive,
                     )
+                    if rec is not None:
+                        rec.event("rollback", epoch=e, rolled_to=rolled_to)
                 else:
                     self.sentinel.note_good()
                 last_metrics["rollbacks"] = self.sentinel.total_rollbacks
+            # Sentinel (and a rollback, when it fires) billed to its own
+            # metric, not to the next epoch's throughput denominator.
+            last_metrics["sentinel_s"] = round(
+                time.perf_counter() - t_sentinel, 4
+            )
+            if rec is not None:
+                rec.lap(_PH_SENTINEL)
 
-            if is_coordinator() and self.tracker is not None:
-                self.tracker.log_metrics(last_metrics, e)
             # Orbax saves of sharded arrays are collective: EVERY process
             # must call save (each host owns shards of the dp-sharded
             # buffer); rank-gating applies only to metric logging.
             # The final epoch always saves, so short runs (< save_every
             # epochs) still produce a checkpoint run_agent can load.
             saved_this_epoch = False
+            t_save = time.perf_counter()
             if (
                 sentinel_ok
                 and self.checkpointer is not None
@@ -923,6 +1004,28 @@ class Trainer:
             ):
                 self._save_checkpoint(e, step)
                 saved_this_epoch = True
+            # The synchronous slice of the save (array fetch + write
+            # dispatch; Orbax finishes the IO in the background).
+            last_metrics["save_s"] = round(time.perf_counter() - t_save, 4)
+            if rec is not None:
+                rec.lap(_PH_CKPT)
+
+            # Logged after the save so sentinel_s/save_s land in the
+            # epoch that paid them.
+            if is_coordinator() and self.tracker is not None:
+                self.tracker.log_metrics(last_metrics, e)
+            if rec is not None:
+                rec.inc("env_steps", env_steps_this_epoch)
+                rec.inc("grad_steps", grad_steps_this_epoch)
+                rec.epoch_end(e, extra={
+                    "step": step,
+                    "env_steps": env_steps_this_epoch,
+                    "grad_steps": grad_steps_this_epoch,
+                    "env_steps_per_sec": round(
+                        last_metrics["env_steps_per_sec"], 2
+                    ),
+                    "saved": saved_this_epoch,
+                })
 
             # --- graceful preemption (single SIGTERM/SIGINT): the
             # epoch is complete and, if it passed the sentinel,
@@ -937,6 +1040,8 @@ class Trainer:
                     self._save_checkpoint(e, step)
                 if self.checkpointer is not None:
                     self.checkpointer.wait()
+                if rec is not None:
+                    rec.event("preempted", epoch=e, urgent=False)
                 raise Preempted(epoch=e)
 
             if hasattr(epoch_iter, "set_postfix"):
@@ -946,13 +1051,21 @@ class Trainer:
             # the reference's extra epoch-boundary reset, ref :305, is a
             # redundant double physics re-init we deliberately drop)
             episode_rewards, episode_lengths = [], []
+            # Restart the epoch clock only now: everything since the
+            # drain (sentinel, save, logging) is accounted above and
+            # must not leak into the next epoch's dt.
+            t_epoch = time.time()
 
         if self.checkpointer is not None:
             self.checkpointer.wait()
         return last_metrics
 
     def close(self):
-        """Release env pool resources (worker processes, shared memory)."""
+        """Release env pool resources (worker processes, shared memory)
+        and finalize telemetry (flush the JSONL sink, stop a profiler
+        trace left open by a short or interrupted run)."""
+        if self.telemetry is not None:
+            self.telemetry.close()
         self.pool.close()
 
     # ------------------------------------------------------------- resume

@@ -120,6 +120,13 @@ class PolicyEngine:
         }
         self._compiled: set = set()  # {(bucket, deterministic)}
         self._lock = threading.Lock()
+        # Precomputed jax.profiler span labels (one per bucket): under
+        # an active trace each serving forward shows up as a labeled
+        # span; with no trace the annotation is a no-op TraceMe, so the
+        # serving hot path pays ~nothing (docs/OBSERVABILITY.md).
+        self._trace_names = {
+            b: f"serve/forward[b{b}]" for b in self.buckets
+        }
 
     # ----------------------------------------------------------- buckets
 
@@ -162,12 +169,13 @@ class PolicyEngine:
         n = int(jax.tree_util.tree_leaves(obs)[0].shape[0])
         bucket = self.bucket_for(n)
         padded = self._pad(obs, n, bucket)
-        if deterministic:
-            out = self._fwd[True](params, padded)
-        else:
-            if key is None:
-                raise ValueError("sampled serving needs a PRNG key")
-            out = self._fwd[False](params, padded, key)
+        with jax.profiler.TraceAnnotation(self._trace_names[bucket]):
+            if deterministic:
+                out = self._fwd[True](params, padded)
+            else:
+                if key is None:
+                    raise ValueError("sampled serving needs a PRNG key")
+                out = self._fwd[False](params, padded, key)
         with self._lock:
             self._compiled.add((bucket, bool(deterministic)))
         return np.asarray(out)[:n]

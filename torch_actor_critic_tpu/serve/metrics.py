@@ -1,27 +1,30 @@
 """Serving metrics: queue depth, batch occupancy, rate, latency tails.
 
 A single lock-guarded accumulator shared by the batcher and the HTTP
-frontend. Latencies keep a bounded sliding window (default 8192
-samples) for percentile estimates — enough resolution for p99 at
-serving rates while bounding memory; total counters never reset, and
-:meth:`snapshot` derives requests/sec over the window between snapshots
-(falling back to lifetime rate on the first call).
+frontend. Latencies feed a **fixed-bucket** log-spaced histogram
+(:class:`~torch_actor_critic_tpu.telemetry.histogram.FixedBucketHistogram`
+— the same estimator the training telemetry snapshot uses, so both
+planes report percentiles through one schema, docs/OBSERVABILITY.md):
+constant memory at any request volume, Prometheus-style cumulative
+semantics (percentiles are over the process lifetime, never reset).
+Total counters never reset either; :meth:`snapshot` derives
+requests/sec over the window between snapshots (falling back to the
+lifetime rate on the first call).
 """
 
 from __future__ import annotations
 
-import collections
 import threading
 import time
 import typing as t
 
-import numpy as np
+from torch_actor_critic_tpu.telemetry.histogram import FixedBucketHistogram
 
 __all__ = ["ServeMetrics"]
 
 
 class ServeMetrics:
-    def __init__(self, latency_window: int = 8192):
+    def __init__(self):
         self._lock = threading.Lock()
         self._t_start = time.perf_counter()
         self._t_snapshot = self._t_start
@@ -34,9 +37,7 @@ class ServeMetrics:
         self.queue_depth = 0
         self._responses_at_snapshot = 0
         self._snapshots_taken = 0
-        self._latencies_ms: collections.deque = collections.deque(
-            maxlen=latency_window
-        )
+        self._latency = FixedBucketHistogram()
 
     # ----------------------------------------------------------- recording
 
@@ -54,7 +55,7 @@ class ServeMetrics:
     def record_done(self, latency_ms: float):
         with self._lock:
             self.responses_total += 1
-            self._latencies_ms.append(latency_ms)
+            self._latency.record(latency_ms)
 
     def record_error(self):
         with self._lock:
@@ -74,7 +75,6 @@ class ServeMetrics:
             self._snapshots_taken += 1
             self._t_snapshot = now
             self._responses_at_snapshot = self.responses_total
-            lat = np.asarray(self._latencies_ms, dtype=np.float64)
             out = {
                 "requests_total": self.requests_total,
                 "responses_total": self.responses_total,
@@ -107,12 +107,16 @@ class ServeMetrics:
                     2,
                 ),
             }
-        if lat.size:
-            p50, p95, p99 = np.percentile(lat, [50, 95, 99])
-            out.update(
-                p50_ms=round(float(p50), 3),
-                p95_ms=round(float(p95), 3),
-                p99_ms=round(float(p99), 3),
-                max_ms=round(float(lat.max()), 3),
-            )
+            # Latency tails AND the mean from the same fixed-bucket
+            # histogram: p50/p95/p99 interpolated (error bounded by the
+            # ~19% bucket width), mean/max exact side counters.
+            if self._latency.count:
+                p50, p95, p99 = self._latency.percentiles((50, 95, 99))
+                out.update(
+                    mean_ms=round(self._latency.mean, 3),
+                    p50_ms=round(p50, 3),
+                    p95_ms=round(p95, 3),
+                    p99_ms=round(p99, 3),
+                    max_ms=round(self._latency.max, 3),
+                )
         return out

@@ -103,8 +103,15 @@ class PolicyServer:
         seed: int = 0,
         request_timeout_s: float = 30.0,
         act_timeout_s: float = 30.0,
+        extra_snapshot: t.Callable[[], dict] | None = None,
     ):
         self.registry = registry
+        # Co-located processes (a trainer serving its own policy, a
+        # custom health exporter) merge their own snapshot into
+        # /metrics — e.g. a telemetry recorder's training phases under
+        # one "training" key, so both planes report through one
+        # endpoint and schema (docs/OBSERVABILITY.md).
+        self.extra_snapshot = extra_snapshot
         # Per-connection socket timeout + bounded wait on the batcher
         # future: without these one stalled client (or a wedged engine)
         # pins a ThreadingHTTPServer handler thread FOREVER — the
@@ -156,7 +163,14 @@ class PolicyServer:
                         "slots": server.registry.slots(),
                     })
                 elif self.path == "/metrics":
-                    self._send(200, server.metrics.snapshot())
+                    snap = server.metrics.snapshot()
+                    if server.extra_snapshot is not None:
+                        try:
+                            snap.update(server.extra_snapshot())
+                        except Exception as e:  # noqa: BLE001 — the
+                            # base snapshot must survive a broken hook
+                            snap["extra_snapshot_error"] = repr(e)[:200]
+                    self._send(200, snap)
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 

@@ -1,0 +1,54 @@
+"""Zero-overhead-when-off observability for training and serving.
+
+The run-time monitoring layer TorchBeast treats as core platform
+infrastructure (arXiv:1910.03552) and Podracer uses to justify its
+actor/learner timing splits (arXiv:2104.06272), built for the
+host<->TPU boundary:
+
+- :mod:`recorder` — monotonic-clock phase timers over a preallocated
+  span ring, aggregated per epoch. No host<->device syncs and no
+  per-step allocation when enabled; when disabled the Trainer holds
+  ``telemetry=None`` and the hot path degenerates to one predicted
+  pointer comparison per phase mark (docs/OBSERVABILITY.md).
+- :mod:`histogram` — fixed-bucket latency histogram (bounded memory),
+  shared with :mod:`~torch_actor_critic_tpu.serve.metrics` so training
+  and serving percentiles come from one estimator.
+- :mod:`memory` — per-epoch device HBM watermarks via
+  ``device.memory_stats()`` (None-safe on CPU).
+- :mod:`profiler` — ``jax.profiler`` integration: named trace
+  annotations and the ``--profile-epochs A:B`` capture window.
+- :mod:`sinks` — JSONL event stream under the Tracker run dir, a human
+  ``summary()`` table, and the ``/metrics``-style snapshot schema.
+"""
+
+from torch_actor_critic_tpu.telemetry.histogram import FixedBucketHistogram
+from torch_actor_critic_tpu.telemetry.memory import device_memory_watermarks
+from torch_actor_critic_tpu.telemetry.profiler import (
+    ProfilerWindow,
+    parse_profile_epochs,
+)
+from torch_actor_critic_tpu.telemetry.recorder import (
+    PHASES,
+    PhaseTimer,
+    SpanRing,
+    TelemetryRecorder,
+)
+from torch_actor_critic_tpu.telemetry.sinks import (
+    JsonlSink,
+    format_summary,
+    json_sanitize,
+)
+
+__all__ = [
+    "PHASES",
+    "FixedBucketHistogram",
+    "JsonlSink",
+    "PhaseTimer",
+    "ProfilerWindow",
+    "SpanRing",
+    "TelemetryRecorder",
+    "device_memory_watermarks",
+    "format_summary",
+    "json_sanitize",
+    "parse_profile_epochs",
+]

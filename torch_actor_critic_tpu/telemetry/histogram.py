@@ -1,0 +1,150 @@
+"""Fixed-bucket latency histogram: percentiles without unbounded lists.
+
+Geometric (log-spaced) bucket bounds give a constant *relative* error
+per estimate — the right trade for latencies, where 1.05ms vs 1.25ms is
+noise but 10ms vs 50ms is the story. Memory is a fixed ``O(n_buckets)``
+int array regardless of how many samples are recorded, so a serving
+process that handles a billion requests holds exactly the same
+footprint as one that handled ten.
+
+Shared by :class:`~torch_actor_critic_tpu.serve.metrics.ServeMetrics`
+(request latencies) and the training-side
+:class:`~torch_actor_critic_tpu.telemetry.recorder.TelemetryRecorder`
+snapshot schema, so both planes report percentiles from the same
+estimator (docs/OBSERVABILITY.md "unified schema").
+
+Not internally locked: callers that share an instance across threads
+guard it with their own lock (``ServeMetrics`` already holds one around
+every recording path).
+"""
+
+from __future__ import annotations
+
+import math
+import typing as t
+
+__all__ = ["FixedBucketHistogram"]
+
+
+class FixedBucketHistogram:
+    """Bounded-memory histogram over ``(0, +inf)`` values.
+
+    Bucket ``i`` covers ``[lo * growth**i, lo * growth**(i+1))``; one
+    underflow bucket catches values below ``lo`` and one overflow
+    bucket values past the top bound. ``growth=2**0.25`` (~19% bucket
+    width) bounds percentile error to under one bucket width while
+    keeping the default 0.01ms..120s span under ~100 counters.
+    """
+
+    def __init__(
+        self,
+        lo: float = 0.01,
+        hi: float = 120_000.0,
+        growth: float = 2 ** 0.25,
+    ):
+        if not (lo > 0 and hi > lo and growth > 1):
+            raise ValueError(
+                f"need 0 < lo < hi and growth > 1, got lo={lo} hi={hi} "
+                f"growth={growth}"
+            )
+        self._lo = float(lo)
+        self._log_lo = math.log(lo)
+        self._log_growth = math.log(growth)
+        n = int(math.ceil((math.log(hi) - self._log_lo) / self._log_growth))
+        # index 0 = underflow (< lo), 1..n = geometric, n+1 = overflow.
+        self._counts = [0] * (n + 2)
+        self._n = n
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = 0.0
+
+    # ------------------------------------------------------------ recording
+
+    def record(self, value: float) -> None:
+        v = float(value)
+        if v < 0.0 or v != v:  # negative or NaN: clock skew, not data
+            return
+        self.count += 1
+        self.total += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if v < self._lo:
+            self._counts[0] += 1
+            return
+        i = int((math.log(v) - self._log_lo) / self._log_growth) + 1
+        if i > self._n:
+            i = self._n + 1
+        self._counts[i] += 1
+
+    # ----------------------------------------------------------- estimation
+
+    def _bound(self, i: int) -> float:
+        """Lower bound of geometric bucket index ``i`` (1-based)."""
+        return math.exp(self._log_lo + (i - 1) * self._log_growth)
+
+    def percentile(self, q: float) -> float | None:
+        """Estimated ``q``-th percentile (``0 <= q <= 100``), or None on
+        an empty histogram. Interpolates linearly inside the bucket;
+        the underflow/overflow buckets clamp to the exact min/max."""
+        if self.count == 0:
+            return None
+        rank = q / 100.0 * self.count
+        seen = 0
+        for i, c in enumerate(self._counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                if i == 0:
+                    return self.min
+                if i == self._n + 1:
+                    return self.max
+                lo, hi = self._bound(i), self._bound(i + 1)
+                frac = (rank - seen) / c
+                # Clamp to the observed extremes: a lone sample in a
+                # bucket is better reported as itself than as the
+                # bucket's geometric interior.
+                return min(max(lo + frac * (hi - lo), self.min), self.max)
+            seen += c
+        return self.max
+
+    def percentiles(self, qs: t.Sequence[float]) -> t.List[float | None]:
+        return [self.percentile(q) for q in qs]
+
+    @property
+    def mean(self) -> float | None:
+        return self.total / self.count if self.count else None
+
+    # ------------------------------------------------------------- export
+
+    def snapshot(self, prefix: str = "", round_to: int = 3) -> dict:
+        """``/metrics``-style keys: count/mean/p50/p95/p99/max (+prefix).
+        Percentile keys are present only when samples exist."""
+        out: dict = {f"{prefix}count": self.count}
+        if self.count:
+            p50, p95, p99 = self.percentiles((50, 95, 99))
+            out.update({
+                f"{prefix}mean_ms": round(self.mean, round_to),
+                f"{prefix}p50_ms": round(p50, round_to),
+                f"{prefix}p95_ms": round(p95, round_to),
+                f"{prefix}p99_ms": round(p99, round_to),
+                f"{prefix}max_ms": round(self.max, round_to),
+            })
+        return out
+
+    def buckets(self) -> t.List[t.Tuple[float, int]]:
+        """Non-empty ``(upper_bound, count)`` pairs, for export/debug.
+        The overflow bucket reports ``inf`` as its bound."""
+        out = []
+        for i, c in enumerate(self._counts):
+            if not c:
+                continue
+            bound = (
+                self._lo if i == 0
+                else math.inf if i == self._n + 1
+                else self._bound(i + 1)
+            )
+            out.append((bound, c))
+        return out

@@ -1,0 +1,53 @@
+"""Device memory watermarks via ``device.memory_stats()``.
+
+A pure host-side runtime query: reading allocator statistics never
+synchronizes the device queue, so sampling once per epoch is free even
+mid-burst. TPU/GPU runtimes report ``bytes_in_use`` /
+``peak_bytes_in_use`` / ``bytes_limit``; XLA:CPU returns ``None`` (or
+raises) — both are mapped to a ``None`` result so CPU smoke runs carry
+an honest "no HBM here" instead of zeros.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+__all__ = ["device_memory_watermarks"]
+
+
+def device_memory_watermarks() -> t.Optional[dict]:
+    """Aggregate HBM watermarks over the local devices, or ``None``
+    when no device exposes allocator stats (the CPU backend).
+
+    Max-aggregated across devices: with replicated params and
+    dp-sharded replay every device carries ~the same footprint, and the
+    watermark question is "how close is the *worst* device to its
+    limit", not the fleet sum.
+    """
+    import jax
+
+    per_device = []
+    for d in jax.local_devices():
+        try:
+            s = d.memory_stats()
+        except Exception:  # noqa: BLE001 — backends without stats
+            s = None
+        if s:
+            per_device.append(s)
+    if not per_device:
+        return None
+    out: dict = {"n_devices": len(per_device)}
+    for key, agg in (
+        ("bytes_in_use", max),
+        ("peak_bytes_in_use", max),
+        ("largest_alloc_size", max),
+        ("bytes_limit", min),
+    ):
+        vals = [s[key] for s in per_device if key in s]
+        if vals:
+            out[f"{key}_{'max' if agg is max else 'min'}"] = int(agg(vals))
+    peak = out.get("peak_bytes_in_use_max")
+    limit = out.get("bytes_limit_min")
+    if peak is not None and limit:
+        out["peak_frac_of_limit"] = round(peak / limit, 4)
+    return out

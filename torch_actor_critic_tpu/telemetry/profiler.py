@@ -1,0 +1,109 @@
+"""``jax.profiler`` integration: epoch-windowed XLA trace capture.
+
+Two pieces:
+
+- :func:`parse_profile_epochs` — the ``--profile-epochs A:B`` CLI
+  syntax (half-open, python-slice style; a bare ``A`` means one epoch).
+- :class:`ProfilerWindow` — starts ``jax.profiler.start_trace`` at the
+  first epoch inside the window and stops it after the last, writing a
+  TensorBoard/xprof-loadable trace (``plugins/profile/<ts>/*``) into
+  the run directory. Profiling whole runs is useless (multi-GB traces,
+  minutes of overhead); a 1-2 epoch window past compile warmup is the
+  workflow docs/OBSERVABILITY.md describes.
+
+The window is resume-aware: a run restored at epoch 7 with window
+``5:8`` starts capturing immediately (``epoch >= start`` rather than
+``epoch == start``), and :meth:`ProfilerWindow.close` stops a trace
+left open by a short or preempted run so the capture file is always
+finalized.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import typing as t
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ProfilerWindow", "parse_profile_epochs"]
+
+
+def parse_profile_epochs(spec: str | None) -> t.Optional[t.Tuple[int, int]]:
+    """``"A:B"`` -> ``(A, B)`` (half-open); ``"A"`` -> ``(A, A+1)``;
+    ``None``/empty -> ``None`` (no profiling)."""
+    if not spec:
+        return None
+    parts = spec.split(":")
+    try:
+        if len(parts) == 1:
+            a = int(parts[0])
+            b = a + 1
+        elif len(parts) == 2:
+            a, b = int(parts[0]), int(parts[1])
+        else:
+            raise ValueError(spec)
+    except ValueError:
+        raise ValueError(
+            f"--profile-epochs expects 'A:B' or 'A' (epochs, half-open), "
+            f"got {spec!r}"
+        ) from None
+    if a < 0 or b <= a:
+        raise ValueError(
+            f"--profile-epochs window must satisfy 0 <= A < B, got {spec!r}"
+        )
+    return a, b
+
+
+class ProfilerWindow:
+    """Capture one XLA trace over the epoch window ``[start, stop)``."""
+
+    def __init__(
+        self,
+        epochs: t.Optional[t.Tuple[int, int]],
+        log_dir: str | os.PathLike | None,
+    ):
+        self.window = tuple(int(e) for e in epochs) if epochs else None
+        self.log_dir = str(log_dir) if log_dir is not None else None
+        self.enabled = self.window is not None and self.log_dir is not None
+        if epochs and self.log_dir is None:
+            logger.warning(
+                "--profile-epochs %s ignored: no run directory to write "
+                "the trace into (tracking disabled?)", epochs,
+            )
+        self._active = False
+        self._done = False
+
+    # ------------------------------------------------------------- epochs
+
+    def epoch_begin(self, epoch: int) -> None:
+        if not self.enabled or self._active or self._done:
+            return
+        start, stop = self.window
+        if start <= epoch < stop:
+            import jax
+
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            logger.info(
+                "profiler: trace started at epoch %d (window %d:%d) -> %s",
+                epoch, start, stop, self.log_dir,
+            )
+
+    def epoch_end(self, epoch: int) -> None:
+        if self._active and epoch >= self.window[1] - 1:
+            self._stop()
+
+    def _stop(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+        logger.info("profiler: trace written to %s", self.log_dir)
+
+    def close(self) -> None:
+        """Finalize a still-open trace (run ended inside the window)."""
+        if self._active:
+            self._stop()

@@ -71,6 +71,16 @@ def parse_arguments(argv=None) -> argparse.Namespace:
         "tensorboard/xprof). Profile short runs: --epochs 2 --steps-per-epoch "
         "500. The reference has no profiling at all (SURVEY.md §5).",
     )
+    parser.add_argument(
+        "--profile-epochs",
+        metavar="A:B",
+        default=None,
+        help="Capture an XLA trace over the half-open epoch window A:B "
+        "into <run_dir>/trace (TensorBoard/xprof-loadable); implies "
+        "--telemetry true. Unlike --profile this bounds the capture to "
+        "a couple of post-warmup epochs — the workflow "
+        "docs/OBSERVABILITY.md describes.",
+    )
     parser.add_argument("--runs-root", default="runs", help="Tracking root directory")
     parser.add_argument(
         "--no-save-buffer",
@@ -151,7 +161,30 @@ def main(argv=None):
     checkpointer = Checkpointer(
         tracker.artifact_path("checkpoints"), save_buffer=args.save_buffer
     )
+    # Telemetry (docs/OBSERVABILITY.md): built here so the CLI-only
+    # --profile-epochs window reaches the recorder; a --telemetry true
+    # run without a window still streams phase spans + HBM watermarks
+    # to <run_dir>/telemetry.jsonl.
+    from torch_actor_critic_tpu.telemetry import (
+        TelemetryRecorder,
+        parse_profile_epochs,
+    )
+
+    profile_window = parse_profile_epochs(args.profile_epochs)
+    telemetry_rec = None
+    if config.telemetry or profile_window:
+        telemetry_rec = TelemetryRecorder(
+            run_dir=tracker.run_dir if tracker.enabled else None,
+            profile_epochs=profile_window,
+        )
     if config.on_device:
+        if telemetry_rec is not None:
+            logger.warning(
+                "telemetry/--profile-epochs are host-Trainer features; "
+                "the fused on-device loop (--on-device true) has no "
+                "host-visible phases to span — use --profile for a "
+                "whole-run trace instead"
+            )
         from torch_actor_critic_tpu.sac.ondevice import train_on_device
 
         logger.info(
@@ -180,6 +213,7 @@ def main(argv=None):
         seed=args.seed,
         render=args.render,
         preemption=guard,
+        telemetry=telemetry_rec,
     )
     if args.run is not None and checkpointer.latest_epoch() is not None:
         start = trainer.restore()
@@ -208,6 +242,12 @@ def main(argv=None):
         trainer.close()
         if guard is not None:
             guard.uninstall()
+        if (
+            telemetry_rec is not None
+            and telemetry_rec.epochs_recorded
+            and is_coordinator()
+        ):
+            logger.info("%s", telemetry_rec.summary())
     logger.info("final metrics: %s", metrics)
     return metrics
 

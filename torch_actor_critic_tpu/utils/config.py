@@ -187,6 +187,14 @@ class SACConfig:
     # sees different env realizations than the run it resumes.
     epoch_reseed: bool = True
 
+    # --- observability (telemetry/, docs/OBSERVABILITY.md) ---
+    # Per-step phase spans (act/env_step/stage/place_chunk/
+    # burst_dispatch/drain/sentinel/checkpoint), per-epoch device HBM
+    # watermarks and a JSONL event stream under the tracker run dir.
+    # Off by default: the disabled hot path carries zero telemetry work
+    # (bench.py `telemetry_overhead` pins the enabled cost at <5%).
+    telemetry: bool = False
+
     def __post_init__(self):
         if not (len(self.filters) == len(self.kernel_sizes) == len(self.strides)):
             raise ValueError(

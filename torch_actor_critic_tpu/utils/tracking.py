@@ -19,10 +19,14 @@ If mlflow IS importable, :class:`Tracker` can mirror logs to it
 from __future__ import annotations
 
 import json
+import logging
+import math
 import time
 import typing as t
 import uuid
 from pathlib import Path
+
+logger = logging.getLogger(__name__)
 
 
 class Tracker:
@@ -74,18 +78,42 @@ class Tracker:
         p = self.run_dir / "params.json"
         return json.loads(p.read_text()) if p.exists() else {}
 
+    @property
+    def metrics_path(self) -> Path:
+        """The append-only JSONL metrics mirror: one strict-JSON object
+        per epoch, flushed per line — external pollers ``tail -f`` this
+        instead of parsing MLflow state (docs/OBSERVABILITY.md)."""
+        return self.run_dir / "metrics.jsonl"
+
     def log_metrics(self, metrics: t.Mapping[str, float], step: int) -> None:
+        """Append one epoch row to the JSONL mirror (and best-effort to
+        the MLflow mirror, when configured).
+
+        The JSONL file is the source of truth: it is written FIRST and
+        flushed per line, and a broken MLflow mirror is logged rather
+        than allowed to lose the row. Non-finite values are mapped to
+        ``null`` — Python's ``json`` would otherwise emit ``NaN``
+        literals that strict JSON parsers (jq, serde, browsers) reject,
+        breaking exactly the external pollers the mirror exists for."""
         if not self.enabled:
             return
-        row = {"step": int(step), "time": time.time()}
-        row.update({k: float(v) for k, v in metrics.items()})
-        with open(self.run_dir / "metrics.jsonl", "a") as f:
+        row: dict = {"step": int(step), "time": time.time()}
+        for k, v in metrics.items():
+            v = float(v)
+            row[k] = v if math.isfinite(v) else None
+        with open(self.metrics_path, "a") as f:
             f.write(json.dumps(row) + "\n")
+            f.flush()
         if self._mlflow:
-            self._mlflow.log_metrics({k: float(v) for k, v in metrics.items()}, step)
+            try:
+                self._mlflow.log_metrics(
+                    {k: float(v) for k, v in metrics.items()}, step
+                )
+            except Exception as e:  # noqa: BLE001 — mirror, not truth
+                logger.warning("mlflow mirror failed at step %d: %r", step, e)
 
     def metrics(self) -> t.List[dict]:
-        p = self.run_dir / "metrics.jsonl"
+        p = self.metrics_path
         if not p.exists():
             return []
         return [json.loads(line) for line in p.read_text().splitlines() if line]
