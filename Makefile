@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke
+.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -51,6 +51,14 @@ serve-smoke:
 # the phase-coverage contract (docs/OBSERVABILITY.md).
 trace-smoke:
 	JAX_PLATFORMS=cpu python scripts/trace_smoke.py
+
+# Learning-health diagnostics smoke: short full-tier CPU train;
+# asserts every diagnostic key is present, finite and schema-valid in
+# telemetry.jsonl/metrics.jsonl, the TD-error histogram merged, and
+# the recompilation watchdog counting (docs/OBSERVABILITY.md
+# "Learning-health diagnostics").
+diag-smoke:
+	JAX_PLATFORMS=cpu python scripts/diag_smoke.py
 
 # Fault-injection suite: every recovery path (NaN rollback, SIGTERM
 # save+requeue+bitwise resume, checkpoint retry/fallback, dead env

@@ -13,7 +13,8 @@ Extra keys: backend, device_kind, mfu, flops_per_step, sweep (batch/
 width MFU scaling), visual (CNN burst at the wall-runner geometry),
 on_device (fused env+update loop throughput), host_envs (worker-pool
 on/off incl. the wall-runner crossover), telemetry_overhead (Trainer
-throughput with telemetry off vs on), and — on any failure —
+throughput with telemetry off vs on), diagnostics_overhead (tiered
+off/light/full learning-health diagnostics cost), and — on any failure —
 "error"/"diagnostics" instead of a silent traceback. Real-chip runs
 snapshot themselves into ``runs/tpu/`` and a CPU-fallback run merges
 the freshest snapshot back as ``last_known_tpu`` (round-3 hardening:
@@ -1274,6 +1275,72 @@ def bench_telemetry_overhead(budget_s=420.0):
     return out
 
 
+def bench_diagnostics_overhead(budget_s=540.0):
+    """Learning-health diagnostics cost (docs/OBSERVABILITY.md
+    "Learning-health diagnostics"): steady-state Trainer throughput at
+    each tier — off (parity), light (scalar grad/Q/saturation
+    reductions fused into the burst) and full (light + the on-device
+    TD-error histogram) — on the tiny CPU config. Acceptance bar:
+    `light` within 5% of `off` (same bar as `telemetry_overhead`)."""
+    import tempfile
+
+    from torch_actor_critic_tpu.parallel import make_mesh
+    from torch_actor_critic_tpu.sac.trainer import Trainer
+    from torch_actor_critic_tpu.utils.config import SACConfig
+    from torch_actor_critic_tpu.utils.tracking import Tracker
+
+    t_start = time.time()
+    out = {}
+    tiny = dict(
+        hidden_sizes=(32, 32), batch_size=32, epochs=4,
+        steps_per_epoch=400, start_steps=50, update_after=50,
+        update_every=50, buffer_size=5000, max_ep_len=200,
+    )
+    # ABBA-ordered tiers (off..full then mirrored) so slow host drift
+    # cancels to first order, exactly like the telemetry stage.
+    rates: dict = {
+        m: [] for tier in ("off", "light", "full")
+        for m in (tier, f"grad_{tier}")
+    }
+    for tier in ("off", "light", "full", "full", "light", "off"):
+        if time.time() - t_start > budget_s:
+            break
+        try:
+            root = tempfile.mkdtemp(prefix="bench_diag_")
+            tracker = Tracker(experiment="bench", root=root)
+            tr = Trainer(
+                "Pendulum-v1", SACConfig(**tiny, diagnostics=tier),
+                mesh=make_mesh(dp=1), tracker=tracker,
+            )
+            try:
+                tr.train()
+            finally:
+                tr.close()
+            rows = tracker.metrics()[1:]  # post-warmup epochs only
+            rates[tier].extend(r["env_steps_per_sec"] for r in rows)
+            rates[f"grad_{tier}"].extend(
+                r["grad_steps_per_sec"] for r in rows
+            )
+        except Exception as e:  # noqa: BLE001 — per-run best effort
+            out.setdefault("errors", []).append(repr(e)[:200])
+    # Best observed epoch per tier (scheduler hiccups only slow epochs
+    # down, so the max is the least-contended estimate).
+    for tier in ("off", "light", "full"):
+        if rates[tier]:
+            out[tier] = {
+                "env_steps_per_sec": round(max(rates[tier]), 1),
+                "grad_steps_per_sec": round(max(rates[f"grad_{tier}"]), 1),
+                "epoch_rates": [round(r, 1) for r in rates[tier]],
+            }
+    off = out.get("off", {}).get("env_steps_per_sec")
+    for tier in ("light", "full"):
+        on = out.get(tier, {}).get("env_steps_per_sec")
+        if off and on:
+            out[f"overhead_{tier}_pct"] = round((off - on) / off * 100, 2)
+    log(f"diagnostics overhead: {out}")
+    return out
+
+
 def bench_torch_cpu(n_steps=300):
     """Reference-style torch-CPU SAC update, timed per gradient step
     incl. uniform replay sampling — the measured stand-in for the
@@ -1373,6 +1440,9 @@ _STAGES = {
     "host_envs": lambda: {"host_envs": bench_host_envs()},
     "telemetry_overhead": lambda: {
         "telemetry_overhead": bench_telemetry_overhead()
+    },
+    "diagnostics_overhead": lambda: {
+        "diagnostics_overhead": bench_diagnostics_overhead()
     },
     "on_device": lambda: {"on_device": bench_on_device()},
     # Two sequence lengths: the O(block)-memory kernel's scaling story —
@@ -1562,6 +1632,18 @@ def main():
     )
     if res and "error" in res:
         diagnostics.append({"telemetry_stage_error": res.pop("error")})
+    if res:
+        out.update(res)
+
+    # 5d. Diagnostics-tier overhead (off/light/full ABBA; the
+    # "light within 5%" acceptance bar of docs/OBSERVABILITY.md
+    # "Learning-health diagnostics") — host+graph cost measured on the
+    # CPU platform like the other instrumentation stages.
+    res = run_stage_subprocess(
+        "diagnostics_overhead", 720, diagnostics, platform="cpu"
+    )
+    if res and "error" in res:
+        diagnostics.append({"diagnostics_stage_error": res.pop("error")})
     if res:
         out.update(res)
 

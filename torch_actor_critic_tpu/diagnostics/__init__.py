@@ -1,0 +1,75 @@
+"""On-device learning-health observability (docs/OBSERVABILITY.md).
+
+PR 3's telemetry answers *where the wall-clock time goes*; this
+subsystem answers *whether learning is healthy* — computed in-graph at
+zero extra host<->device syncs (the Podracer keep-it-compiled
+principle, arXiv:2104.06272) and surfaced through the same telemetry
+sinks and /metrics schema (the monitoring-first platform posture of
+TorchBeast, arXiv:1910.03552):
+
+- :mod:`ingraph` — the device half: gradient global-norms and
+  update-to-param ratios, Q-value stats (min/max/ensemble spread/
+  target-vs-online bias), tanh action saturation, the fixed-bucket
+  TD-error histogram, and the suffix-keyed reduction convention that
+  carries them through scan, mesh collectives and epoch aggregation.
+- :mod:`monitor` — host-side drift detectors turning the epoch stream
+  into early-warning events (grad spike, entropy collapse, Q-bias
+  drift) feeding telemetry and the divergence sentinel as leading
+  indicators.
+- :mod:`watchdog` — the process-wide XLA recompilation watchdog:
+  counts every backend compile with a source label and flags
+  steady-state recompiles as anomalies in both training and serving.
+
+Tiering is ``SACConfig.diagnostics``: ``off`` (default — compiled
+graph and metric keys bitwise identical to an uninstrumented build),
+``light`` (scalar diagnostics), ``full`` (light + TD histogram + dp
+skew). The tier is baked into the traced update at construction, so it
+is part of the jit identity and flipping it never aliases a cache
+entry.
+"""
+
+from torch_actor_critic_tpu.diagnostics.ingraph import (
+    TD_HIST_GROWTH,
+    TD_HIST_HI,
+    TD_HIST_LO,
+    bucket_counts,
+    cross_replica_reduce,
+    global_norm,
+    make_td_histogram,
+    norm_ratio,
+    reduce_burst_metrics,
+    reduce_metric_rows,
+    reduction_for,
+    replica_skew,
+    saturation_fraction,
+)
+from torch_actor_critic_tpu.diagnostics.monitor import (
+    DEFAULT_RULES,
+    DriftDetector,
+    EarlyWarningMonitor,
+)
+from torch_actor_critic_tpu.diagnostics.watchdog import (
+    RecompilationWatchdog,
+    get_watchdog,
+)
+
+__all__ = [
+    "DEFAULT_RULES",
+    "DriftDetector",
+    "EarlyWarningMonitor",
+    "RecompilationWatchdog",
+    "TD_HIST_GROWTH",
+    "TD_HIST_HI",
+    "TD_HIST_LO",
+    "bucket_counts",
+    "cross_replica_reduce",
+    "get_watchdog",
+    "global_norm",
+    "make_td_histogram",
+    "norm_ratio",
+    "reduce_burst_metrics",
+    "reduce_metric_rows",
+    "reduction_for",
+    "replica_skew",
+    "saturation_fraction",
+]

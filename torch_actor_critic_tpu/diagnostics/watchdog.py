@@ -1,0 +1,213 @@
+"""Process-wide XLA recompilation watchdog.
+
+A steady-state jit recompilation is one of the costliest silent
+failures on TPU: a stray weak-typed scalar, a changed donation
+pattern, or a hot-reload that alters a dtype makes XLA recompile a
+program that was supposed to be cached — multi-second stalls that look
+like "the accelerator is slow" rather than "we are compiling on the
+hot path". The reference (and most RL stacks) has no way to even see
+this happening.
+
+The watchdog hooks :mod:`jax.monitoring` — specifically the
+``/jax/core/compile/backend_compile_duration`` event, which fires
+exactly once per actual XLA backend compile and never on a jit-cache
+hit — and attributes each compile to a **source label** via a
+thread-local context stack:
+
+    with watchdog.source("train/update_burst"):
+        state, buf, m = dp.update_burst(...)     # compiles land here
+
+Sources that have declared themselves **steady** (``mark_steady``
+with their label prefix) flag any further compile as an anomaly —
+logged, counted, and surfaced on the serving ``/metrics`` snapshot and
+in ``telemetry.jsonl``. Warmup/compile phases inside a steady regime
+(a new model slot registering mid-flight) wrap themselves in
+:meth:`expected` to stay anomaly-free while still being counted.
+
+One singleton per process (:func:`get_watchdog`); the listener is
+registered once on :meth:`install` and afterwards costs one string
+compare per monitoring event. Compile counts are *XLA program*
+compiles, which can exceed user-visible jit sites (helper programs,
+multi-computation lowerings) — honest accounting, documented in
+docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import typing as t
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["RecompilationWatchdog", "get_watchdog"]
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_UNATTRIBUTED = "unattributed"
+_MAX_ANOMALIES = 100  # bounded memory; the counter keeps the true total
+
+
+class _SourceCtx:
+    """Reentrant, reusable context manager pushing a source label onto
+    the owning watchdog's thread-local stack."""
+
+    __slots__ = ("_wd", "_label")
+
+    def __init__(self, wd: "RecompilationWatchdog", label: str):
+        self._wd = wd
+        self._label = label
+
+    def __enter__(self):
+        stack = getattr(self._wd._tls, "stack", None)
+        if stack is None:
+            stack = self._wd._tls.stack = []
+        stack.append(self._label)
+        return self
+
+    def __exit__(self, *exc):
+        self._wd._tls.stack.pop()
+        return False
+
+
+class _ExpectedCtx:
+    __slots__ = ("_wd",)
+
+    def __init__(self, wd: "RecompilationWatchdog"):
+        self._wd = wd
+
+    def __enter__(self):
+        self._wd._tls.expected = getattr(self._wd._tls, "expected", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        self._wd._tls.expected -= 1
+        return False
+
+
+class RecompilationWatchdog:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.installed = False
+        self.compiles_total = 0
+        self.by_source: t.Dict[str, int] = {}
+        self.compile_time_s = 0.0
+        self.post_steady_total = 0
+        self.anomalies: t.List[dict] = []
+        self._steady_prefixes: t.Set[str] = set()
+
+    # ------------------------------------------------------------ install
+
+    def install(self) -> "RecompilationWatchdog":
+        """Register the jax.monitoring listener (idempotent)."""
+        with self._lock:
+            if self.installed:
+                return self
+            self.installed = True
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(self._on_event)
+        return self
+
+    # ------------------------------------------------------- attribution
+
+    def source(self, label: str) -> _SourceCtx:
+        """Context manager attributing compiles in the dynamic extent
+        of the with-block (same thread) to ``label``. The returned
+        object is reusable and reentrant — hot paths can construct it
+        once and enter it per dispatch."""
+        return _SourceCtx(self, label)
+
+    def expected(self) -> _ExpectedCtx:
+        """Context manager marking compiles as expected: counted, but
+        never flagged as steady-state anomalies (warmup of a model slot
+        registered after the serving plane went steady)."""
+        return _ExpectedCtx(self)
+
+    # ----------------------------------------------------- steady regime
+
+    def mark_steady(self, prefix: str) -> None:
+        """Declare sources starting with ``prefix`` steady: every later
+        compile attributed to them is an anomaly. Scoped by prefix so
+        the training and serving planes (and independent test cases in
+        one process) manage their own regimes."""
+        with self._lock:
+            self._steady_prefixes.add(prefix)
+
+    def clear_steady(self, prefix: str) -> None:
+        with self._lock:
+            self._steady_prefixes.discard(prefix)
+
+    # ----------------------------------------------------------- listener
+
+    def _on_event(self, name: str, secs: float, **kw) -> None:
+        if name != _COMPILE_EVENT:
+            return
+        stack = getattr(self._tls, "stack", None)
+        src = stack[-1] if stack else _UNATTRIBUTED
+        expected = getattr(self._tls, "expected", 0) > 0
+        with self._lock:
+            self.compiles_total += 1
+            self.by_source[src] = self.by_source.get(src, 0) + 1
+            self.compile_time_s += secs
+            steady = not expected and any(
+                src.startswith(p) for p in self._steady_prefixes
+            )
+            if not steady:
+                return
+            self.post_steady_total += 1
+            anomaly = {
+                "source": src,
+                "time": time.time(),
+                "duration_s": round(secs, 3),
+                "count_at": self.compiles_total,
+            }
+            if len(self.anomalies) < _MAX_ANOMALIES:
+                self.anomalies.append(anomaly)
+        logger.warning(
+            "steady-state XLA recompilation from %s (%.2fs): a program "
+            "that should be jit-cached was rebuilt on the hot path — "
+            "check for varying shapes/dtypes/donation at this call site "
+            "(docs/OBSERVABILITY.md recompile-watchdog runbook)",
+            src, secs,
+        )
+
+    # ----------------------------------------------------------- reports
+
+    def snapshot(self) -> dict:
+        """``/metrics``-style view (also embedded in telemetry.jsonl
+        epoch events by the Trainer)."""
+        with self._lock:
+            return {
+                "compiles_total": self.compiles_total,
+                "compile_time_s": round(self.compile_time_s, 3),
+                "by_source": dict(self.by_source),
+                "post_steady_compiles": self.post_steady_total,
+                "anomalies": list(self.anomalies),
+            }
+
+    def reset(self) -> None:
+        """Zero all counts and steady regimes (test isolation; the
+        listener registration is left in place)."""
+        with self._lock:
+            self.compiles_total = 0
+            self.by_source = {}
+            self.compile_time_s = 0.0
+            self.post_steady_total = 0
+            self.anomalies = []
+            self._steady_prefixes = set()
+
+
+_WATCHDOG: RecompilationWatchdog | None = None
+_SINGLETON_LOCK = threading.Lock()
+
+
+def get_watchdog() -> RecompilationWatchdog:
+    """The process-wide watchdog (created lazily, never installed until
+    someone calls :meth:`~RecompilationWatchdog.install`)."""
+    global _WATCHDOG
+    with _SINGLETON_LOCK:
+        if _WATCHDOG is None:
+            _WATCHDOG = RecompilationWatchdog()
+        return _WATCHDOG

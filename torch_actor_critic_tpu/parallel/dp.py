@@ -44,9 +44,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torch_actor_critic_tpu.buffer.replay import init_replay_buffer
 from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
+from torch_actor_critic_tpu.diagnostics import ingraph as diag
 from torch_actor_critic_tpu.parallel import sharding as tp_sharding
 from torch_actor_critic_tpu.parallel.mesh import global_device_put
 from torch_actor_critic_tpu.sac.algorithm import SAC, Metrics
+
+# Per-device metrics whose cross-replica spread (pmax - pmin) is the
+# replica-desync leading indicator (docs/OBSERVABILITY.md): param-norm
+# skew must be exactly 0.0 while pmean'd grads keep replicas
+# bit-identical; grad-norm skew tracks per-shard batch disagreement.
+_SKEW_KEYS = ("diag/grad_norm_q", "diag/grad_norm_pi", "diag/param_norm")
 
 
 def _dp_specs(mesh: Mesh):
@@ -332,7 +339,19 @@ class DataParallelSAC:
             state_out = local.replace(
                 rng=jax.random.fold_in(state.rng, jnp.uint32(0xB0057))
             )
-            metrics = jax.lax.pmean(metrics, axes)
+            if sac.config.diagnostics == "off":
+                # Parity path: the historical whole-tree pmean, traced
+                # bit-identically to a build without diagnostics.
+                metrics = jax.lax.pmean(metrics, axes)
+            else:
+                skew = (
+                    diag.replica_skew(metrics, _SKEW_KEYS, "dp")
+                    if mesh.shape["dp"] > 1 else {}
+                )
+                # Suffix-aware collectives: per-burst maxima stay
+                # maxima across replicas, histogram counts add.
+                metrics = diag.cross_replica_reduce(metrics, axes)
+                metrics.update(skew)
             # Re-attach the device axis for the dp-sharded outputs.
             buffer = jax.tree_util.tree_map(lambda x: x[None], buffer)
             return state_out, buffer, metrics

@@ -66,7 +66,17 @@ def tree_all_finite(*trees: t.Any) -> bool:
 
 
 class DivergenceSentinel:
-    """Rollback budget + bookkeeping around :func:`tree_all_finite`."""
+    """Rollback budget + bookkeeping around :func:`tree_all_finite`.
+
+    Also the accounting point for **leading indicators**: the
+    diagnostics early-warning monitor
+    (:mod:`torch_actor_critic_tpu.diagnostics.monitor`) reports grad
+    spikes / entropy collapses / Q-bias drift here via
+    :meth:`note_warning` — epochs before any of them matures into the
+    NaN this sentinel detects, so the telemetry stream shows the
+    warning→divergence causality and operators can act on the warning
+    (docs/RESILIENCE.md "Early warnings").
+    """
 
     def __init__(self, max_rollbacks: int = 3):
         if max_rollbacks < 0:
@@ -76,6 +86,8 @@ class DivergenceSentinel:
         self.max_rollbacks = max_rollbacks
         self.consecutive = 0
         self.total_rollbacks = 0
+        self.warnings_total = 0
+        self.warnings_by_kind: t.Dict[str, int] = {}
 
     def check(self, *trees: t.Any) -> bool:
         """One sentinel pass; ``False`` means the caller must roll back
@@ -85,6 +97,15 @@ class DivergenceSentinel:
     def note_good(self) -> None:
         """A validated interval closes any divergence streak."""
         self.consecutive = 0
+
+    def note_warning(self, kind: str) -> None:
+        """Record a leading-indicator warning (no rollback, no budget
+        consumed): the sentinel is the one place both early warnings
+        and actual divergences are tallied, so their correlation is
+        readable from a single object (and metrics.jsonl carries both
+        ``early_warnings`` and ``rollbacks``)."""
+        self.warnings_total += 1
+        self.warnings_by_kind[kind] = self.warnings_by_kind.get(kind, 0) + 1
 
     def note_divergence(self, where: str = "training state") -> None:
         """Account one divergence; raises :class:`TrainingDiverged`

@@ -32,6 +32,7 @@ from flax import linen as nn
 
 from torch_actor_critic_tpu.buffer.replay import push, sample
 from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
+from torch_actor_critic_tpu.diagnostics import ingraph as diag
 from torch_actor_critic_tpu.ops.polyak import polyak_update
 from torch_actor_critic_tpu.ops.augment import augment_batch
 from torch_actor_critic_tpu.sac import losses
@@ -134,8 +135,18 @@ class SAC:
         ``mpi_avg_grads`` (ref ``sac/mpi.py:77-85``), applied to *both*
         critic and actor grads (deliberately fixing the reference's
         misordering at ``sac/algorithm.py:155-156``).
+
+        ``config.diagnostics != "off"`` fuses the learning-health
+        reductions (:mod:`torch_actor_critic_tpu.diagnostics.ingraph`)
+        into this same program: gradient global-norms are taken on the
+        PRE-pmean per-device grads (so dp skew is observable), update
+        ratios after the optax transform, Q stats and the TD-error
+        histogram from the raw surfaces the critic loss already
+        materialized. ``"off"`` traces bit-identically to a build
+        without this code.
         """
         cfg = self.config
+        tier = cfg.diagnostics
         if cfg.frame_augment != "none":
             rng, key_q, key_pi, key_aug = jax.random.split(state.rng, 4)
             batch = augment_batch(
@@ -166,13 +177,24 @@ class SAC:
             alpha=alpha,
             gamma=cfg.gamma,
             reward_scale=cfg.reward_scale,
+            diagnostics=tier != "off",
         )
+        diag_q = q_aux.pop("diag_q", None)
+        diag_backup = q_aux.pop("diag_backup", None)
+        diag_metrics: Metrics = {}
+        if tier != "off":
+            # Pre-pmean: per-device norm, so replica skew is visible.
+            diag_metrics["diag/grad_norm_q"] = diag.global_norm(q_grads)
         if axis_name is not None:
             q_grads = jax.lax.pmean(q_grads, axis_name)
         q_updates, q_opt_state = self.q_tx.update(
             q_grads, state.q_opt_state, state.critic_params
         )
         critic_params = optax.apply_updates(state.critic_params, q_updates)
+        if tier != "off":
+            diag_metrics["diag/update_ratio_q"] = diag.norm_ratio(
+                q_updates, state.critic_params
+            )
 
         # --- actor step (critic frozen by construction: grad w.r.t.
         # actor params only) ---
@@ -187,13 +209,21 @@ class SAC:
             key=key_pi,
             alpha=alpha,
             parity_pi_obs=cfg.parity_pi_obs,
+            diagnostics=tier != "off",
         )
+        diag_pi = pi_aux.pop("diag_pi", None)
+        if tier != "off":
+            diag_metrics["diag/grad_norm_pi"] = diag.global_norm(pi_grads)
         if axis_name is not None:
             pi_grads = jax.lax.pmean(pi_grads, axis_name)
         pi_updates, pi_opt_state = self.pi_tx.update(
             pi_grads, state.pi_opt_state, state.actor_params
         )
         actor_params = optax.apply_updates(state.actor_params, pi_updates)
+        if tier != "off":
+            diag_metrics["diag/update_ratio_pi"] = diag.norm_ratio(
+                pi_updates, state.actor_params
+            )
 
         # --- entropy temperature (extension; no-op graph when fixed) ---
         log_alpha = state.log_alpha
@@ -204,12 +234,18 @@ class SAC:
                     la, pi_aux["logp_pi"], self.target_entropy
                 )
             )(state.log_alpha)
+            if tier != "off":
+                diag_metrics["diag/grad_norm_alpha"] = jnp.abs(a_grad)
             if axis_name is not None:
                 a_grad = jax.lax.pmean(a_grad, axis_name)
             a_updates, alpha_opt_state = self.alpha_tx.update(
                 a_grad, state.alpha_opt_state, state.log_alpha
             )
             log_alpha = optax.apply_updates(state.log_alpha, a_updates)
+            if tier != "off":
+                diag_metrics["diag/update_ratio_alpha"] = jnp.abs(
+                    a_updates
+                ) / (jnp.abs(state.log_alpha) + 1e-12)
 
         # --- polyak target update (ref sac/algorithm.py:77-81) ---
         target_critic_params = polyak_update(
@@ -234,6 +270,14 @@ class SAC:
             **q_aux,
             **pi_aux,
         }
+        if tier != "off":
+            metrics.update(diag_metrics)
+            metrics.update(
+                _shared_diagnostics(
+                    cfg, loss_q, loss_pi, diag_q, diag_backup, diag_pi,
+                    float(getattr(self.actor_def, "act_limit", 1.0)),
+                )
+            )
         return new_state, metrics
 
     # --------------------------------------------------------------- burst
@@ -260,6 +304,51 @@ class SAC:
         )
 
 
+def _shared_diagnostics(
+    config: SACConfig,
+    loss_q: jax.Array,
+    loss_pi: jax.Array,
+    diag_q: jax.Array | None,
+    diag_backup: jax.Array | None,
+    diag_pi: jax.Array | None,
+    act_limit: float,
+) -> Metrics:
+    """Algorithm-independent in-graph diagnostics shared by SAC and TD3
+    (both pass the raw Q surface, backup vector and policy actions
+    their losses already materialized). Key suffixes select the
+    reduction each metric carries through the burst scan, mesh
+    collectives and epoch aggregation (see
+    :mod:`torch_actor_critic_tpu.diagnostics.ingraph`)."""
+    metrics: Metrics = {
+        # Per-burst maxima: a single-step spike inside a 50-update
+        # burst survives to metrics.jsonl instead of averaging away.
+        "loss_q_max": loss_q,
+        "loss_pi_max": loss_pi,
+    }
+    if diag_q is not None and diag_backup is not None:
+        metrics.update({
+            "diag/q_min": jnp.min(diag_q),
+            "diag/q_max": jnp.max(diag_q),
+            # Ensemble (twin-Q) disagreement: per-sample head spread.
+            "diag/q_spread": jnp.mean(
+                jnp.max(diag_q, axis=0) - jnp.min(diag_q, axis=0)
+            ),
+            # Online-vs-target bias: the Q-overestimation drift signal.
+            "diag/q_bias": jnp.mean(diag_q) - jnp.mean(diag_backup),
+        })
+        if config.diagnostics == "full":
+            abs_td = jnp.abs(diag_q - diag_backup[None, :])
+            metrics.update({
+                "diag/td_hist": diag.bucket_counts(abs_td),
+                "diag/td_abs_min": jnp.min(abs_td),
+                "diag/td_abs_max": jnp.max(abs_td),
+                "diag/td_abs_sum": jnp.sum(abs_td),
+            })
+    if diag_pi is not None:
+        metrics["diag/act_sat"] = diag.saturation_fraction(diag_pi, act_limit)
+    return metrics
+
+
 def run_update_burst(
     update_fn: t.Callable[[TrainState, Batch, str | None],
                           t.Tuple[TrainState, Metrics]],
@@ -273,7 +362,12 @@ def run_update_burst(
     """The push-then-scan burst shared by every learner (SAC here, TD3
     in :mod:`torch_actor_critic_tpu.td3`): algorithm choice lives
     entirely in ``update_fn``; the burst scheduling (sampling inside
-    the compiled program, scan unroll) is algorithm-independent."""
+    the compiled program, scan unroll) is algorithm-independent.
+
+    Metric reduction over the scan axis is suffix-keyed
+    (:func:`~torch_actor_critic_tpu.diagnostics.ingraph.reduce_burst_metrics`);
+    none of the base metric keys match a special suffix, so without
+    diagnostics this is exactly the historical per-burst mean."""
     buffer_state = push(buffer_state, chunk)
 
     def body(carry, _):
@@ -288,5 +382,12 @@ def run_update_burst(
         body, (state, buffer_state), xs=None, length=num_updates,
         unroll=config.resolved_burst_unroll,
     )
-    metrics = jax.tree_util.tree_map(jnp.mean, metrics)
+    metrics = diag.reduce_burst_metrics(metrics)
+    if config.diagnostics != "off":
+        # Post-burst parameter norm: per-device, so the dp wrapper can
+        # take its replica skew — the desync canary that must read 0.0
+        # while pmean'd grads keep replicas bit-identical.
+        metrics["diag/param_norm"] = diag.global_norm(
+            state.actor_params, state.critic_params
+        )
     return state, buffer_state, metrics

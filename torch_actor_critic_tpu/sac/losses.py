@@ -45,6 +45,7 @@ def critic_loss(
     alpha: jax.Array,
     gamma: float,
     reward_scale: float,
+    diagnostics: bool = False,
 ) -> t.Tuple[jax.Array, t.Dict[str, jax.Array]]:
     """Twin-critic Bellman MSE (ref ``eval_q_loss``, ``sac/algorithm.py:46-74``).
 
@@ -52,6 +53,12 @@ def critic_loss(
     - alpha * logp(a'|s')), a' ~ pi(.|s'); loss = sum_i mean((Q_i(s,a) -
     backup)^2). The backup is wrapped in ``stop_gradient`` — the
     functional equivalent of the reference's ``torch.no_grad()`` block.
+
+    ``diagnostics=True`` additionally returns the raw ``(num_qs, B)``
+    Q surface and the backup vector under ``diag_q``/``diag_backup``
+    (stop-gradient'd) so the learner can reduce Q stats and TD-error
+    histograms in-graph without recomputing the forward — the caller
+    pops them from the aux before they reach metrics.
     """
     next_action, next_logp = actor_apply(actor_params, batch.next_states, key)
     q_target = critic_apply(target_critic_params, batch.next_states, next_action)
@@ -65,6 +72,9 @@ def critic_loss(
     # Sum of per-head mean MSEs, like loss_q1 + loss_q2 (ref :69-74).
     loss = jnp.sum(jnp.mean((q - backup[None, :]) ** 2, axis=-1))
     aux = {"q_mean": jnp.mean(q), "backup_mean": jnp.mean(backup)}
+    if diagnostics:
+        aux["diag_q"] = jax.lax.stop_gradient(q)
+        aux["diag_backup"] = backup
     return loss, aux
 
 
@@ -78,6 +88,7 @@ def actor_loss(
     key: jax.Array,
     alpha: jax.Array,
     parity_pi_obs: bool = False,
+    diagnostics: bool = False,
 ) -> t.Tuple[jax.Array, t.Dict[str, jax.Array]]:
     """Policy loss (ref ``eval_pi_loss``, ``sac/algorithm.py:30-43``).
 
@@ -85,6 +96,10 @@ def actor_loss(
     differentiated (grad is taken w.r.t. ``actor_params`` only), which
     subsumes the reference's requires_grad freeze/unfreeze dance
     (ref ``sac/algorithm.py:144-160``).
+
+    ``diagnostics=True`` returns the raw policy actions under
+    ``diag_pi`` (stop-gradient'd; popped by the caller) for the
+    tanh-saturation reduction.
     """
     pi_obs = batch.next_states if parity_pi_obs else batch.states
     pi, logp_pi = actor_apply(actor_params, pi_obs, key)
@@ -92,6 +107,8 @@ def actor_loss(
     q_pi_min = jnp.min(q_pi, axis=0)
     loss = jnp.mean(alpha * logp_pi - q_pi_min)
     aux = {"logp_pi": jnp.mean(logp_pi), "entropy": -jnp.mean(logp_pi)}
+    if diagnostics:
+        aux["diag_pi"] = jax.lax.stop_gradient(pi)
     return loss, aux
 
 

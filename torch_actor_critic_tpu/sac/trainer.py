@@ -27,6 +27,7 @@ SURVEY.md §2 "Parallelism strategies") with ranks -> mesh slices.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 import typing as t
@@ -345,6 +346,33 @@ class Trainer:
                 )
             )
         self.telemetry = telemetry
+        # Learning-health diagnostics (diagnostics/, docs/OBSERVABILITY
+        # .md): with a tier on, per-burst in-graph metric rows are
+        # collected (device arrays — no sync until the epoch drain),
+        # reduced at epoch end, streamed to metrics.jsonl/telemetry,
+        # fed through the early-warning monitor into the sentinel, and
+        # the XLA recompilation watchdog attributes every compile to
+        # its dispatch site. "off" leaves all of this as None — zero
+        # hot-path work and byte-identical metric keys.
+        if self.config.diagnostics != "off":
+            from torch_actor_critic_tpu.diagnostics import (
+                EarlyWarningMonitor,
+                get_watchdog,
+                make_td_histogram,
+                reduce_metric_rows,
+            )
+
+            self.monitor = EarlyWarningMonitor()
+            self.td_hist = make_td_histogram()
+            self._reduce_rows = reduce_metric_rows
+            self.watchdog = get_watchdog().install()
+            self._wd_anomalies_seen = len(self.watchdog.snapshot()["anomalies"])
+            self._first_update_epoch: int | None = None
+        else:
+            self.monitor = None
+            self.td_hist = None
+            self.watchdog = None
+        self._diag_rows: t.List[dict] = []
 
         # One env per dp mesh slice, stepped as a pool: sequential
         # in-process by default, parallel worker processes over the
@@ -837,17 +865,30 @@ class Trainer:
                             self._host_params = (
                                 self._fetch_params_single_transfer()
                             )
-                        if rec is None:
+                        if rec is None and self.watchdog is None:
                             self.state, self.buffer, m = self.dp.update_burst(
                                 self.state, self.buffer, chunk,
                                 cfg.updates_per_window,
                             )
                         else:
-                            # Named XLA-trace span: the burst dispatch
+                            # Named XLA-trace span (the burst dispatch
                             # shows up labeled in a --profile-epochs
-                            # capture (the device-side execution it
-                            # queues surfaces under `drain`).
-                            with rec.annotate("train/update_burst"):
+                            # capture; queued device execution surfaces
+                            # under `drain`) and/or watchdog source
+                            # attribution (any compile in this dispatch
+                            # belongs to the burst — post-steady ones
+                            # are hot-path recompile anomalies).
+                            with contextlib.ExitStack() as stack:
+                                if self.watchdog is not None:
+                                    stack.enter_context(
+                                        self.watchdog.source(
+                                            "train/update_burst"
+                                        )
+                                    )
+                                if rec is not None:
+                                    stack.enter_context(
+                                        rec.annotate("train/update_burst")
+                                    )
                                 self.state, self.buffer, m = (
                                     self.dp.update_burst(
                                         self.state, self.buffer, chunk,
@@ -860,6 +901,16 @@ class Trainer:
                         # so bursts stay async behind the env loop.
                         losses_q.append(m["loss_q"])
                         losses_pi.append(m["loss_pi"])
+                        if self.monitor is not None:
+                            # Everything beyond the two loss series —
+                            # diagnostics AND the aux metrics (q_mean,
+                            # entropy, alpha, ...) the pre-diagnostics
+                            # trainer dropped on the floor. Device
+                            # arrays only; fetched once at epoch end.
+                            self._diag_rows.append({
+                                k: v for k, v in m.items()
+                                if k not in ("loss_q", "loss_pi")
+                            })
                     else:
                         self.buffer = self.dp.push_chunk(self.buffer, chunk)
                     if rec is not None:
@@ -936,8 +987,64 @@ class Trainer:
                 "env_steps_per_sec": env_steps_this_epoch / dt,
                 "grad_steps_per_sec": grad_steps_this_epoch / dt,
             }
-            # The loss materialization above is a device fetch: charge
-            # it (plus the drain) to the `drain` phase.
+            # The loss materialization above and the diagnostics fetch
+            # below are device fetches: charge them (plus the drain) to
+            # the `drain` phase.
+            # --- learning-health diagnostics (diagnostics/): ONE
+            # device fetch for the epoch's per-burst diag rows (they
+            # rode the same executables as the losses, so the drain
+            # above already paid for them), suffix-reduced host-side.
+            # Scalars land in metrics.jsonl; the TD-error counts merge
+            # into the shared fixed-bucket histogram schema; the drift
+            # monitor turns the stream into early-warning events that
+            # feed telemetry and the sentinel as leading indicators.
+            if self.monitor is not None and self._diag_rows:
+                reduced = self._reduce_rows(jax.device_get(self._diag_rows))
+                self._diag_rows = []
+                hist = reduced.pop("diag/td_hist", None)
+                if hist is not None:
+                    self.td_hist.merge_counts(
+                        hist,
+                        total=float(reduced.get("diag/td_abs_sum", 0.0)),
+                        vmin=float(reduced.get("diag/td_abs_min", np.inf)),
+                        vmax=float(reduced.get("diag/td_abs_max", 0.0)),
+                    )
+                for k, v in reduced.items():
+                    last_metrics[k] = float(v)
+                for w in self.monitor.update(reduced):
+                    logger.warning(
+                        "early warning %s: %s=%.4g vs baseline %.4g "
+                        "(deviation envelope %.4g) — leading indicator, "
+                        "see docs/OBSERVABILITY.md",
+                        w["kind"], w["key"], w["value"], w["baseline"],
+                        w["spread"],
+                    )
+                    if self.sentinel is not None:
+                        self.sentinel.note_warning(w["kind"])
+                    if rec is not None:
+                        rec.event("early_warning", epoch=e, **w)
+                last_metrics["early_warnings"] = (
+                    self.sentinel.warnings_total
+                    if self.sentinel is not None
+                    else self.monitor.fired_total
+                )
+                if rec is not None:
+                    rec.event(
+                        "diagnostics", epoch=e,
+                        metrics={k: float(v) for k, v in reduced.items()},
+                        td_hist=(
+                            self.td_hist.snapshot(prefix="td_abs_", unit="")
+                            if hist is not None else None
+                        ),
+                    )
+            if self.watchdog is not None:
+                wd_snap = self.watchdog.snapshot()
+                last_metrics["xla_compiles"] = wd_snap["compiles_total"]
+                new_anoms = wd_snap["anomalies"][self._wd_anomalies_seen:]
+                self._wd_anomalies_seen = len(wd_snap["anomalies"])
+                if rec is not None:
+                    for a in new_anoms:
+                        rec.event("recompile_anomaly", epoch=e, **a)
             if rec is not None:
                 rec.lap(_PH_DRAIN)
             if self.population > 1:
@@ -1017,7 +1124,7 @@ class Trainer:
             if rec is not None:
                 rec.inc("env_steps", env_steps_this_epoch)
                 rec.inc("grad_steps", grad_steps_this_epoch)
-                rec.epoch_end(e, extra={
+                extra = {
                     "step": step,
                     "env_steps": env_steps_this_epoch,
                     "grad_steps": grad_steps_this_epoch,
@@ -1025,7 +1132,23 @@ class Trainer:
                         last_metrics["env_steps_per_sec"], 2
                     ),
                     "saved": saved_this_epoch,
-                })
+                }
+                if self.watchdog is not None:
+                    extra["xla_compiles"] = last_metrics.get("xla_compiles")
+                rec.epoch_end(e, extra=extra)
+            # Recompilation-watchdog steady marking: the first update
+            # epoch pays the burst compile, and its END pays the
+            # sentinel/save/mirror compiles — so the regime is declared
+            # steady one full epoch later, after which any compile
+            # attributed to the burst dispatch is a hot-path anomaly.
+            if self.watchdog is not None:
+                if losses_q and self._first_update_epoch is None:
+                    self._first_update_epoch = e
+                elif (
+                    self._first_update_epoch is not None
+                    and e > self._first_update_epoch
+                ):
+                    self.watchdog.mark_steady("train/")
 
             # --- graceful preemption (single SIGTERM/SIGINT): the
             # epoch is complete and, if it passed the sentinel,
@@ -1045,7 +1168,15 @@ class Trainer:
                 raise Preempted(epoch=e)
 
             if hasattr(epoch_iter, "set_postfix"):
-                epoch_iter.set_postfix({**last_metrics, "step": step})
+                # Diagnostic keys stay in metrics.jsonl/telemetry; the
+                # progress line keeps the historical compact view.
+                epoch_iter.set_postfix({
+                    **{
+                        k: v for k, v in last_metrics.items()
+                        if not k.startswith("diag/")
+                    },
+                    "step": step,
+                })
 
             # (envs were already reset by the epoch_ended branch above —
             # the reference's extra epoch-boundary reset, ref :305, is a
@@ -1064,6 +1195,11 @@ class Trainer:
         """Release env pool resources (worker processes, shared memory)
         and finalize telemetry (flush the JSONL sink, stop a profiler
         trace left open by a short or interrupted run)."""
+        if self.watchdog is not None:
+            # The steady regime belongs to THIS trainer's compiled
+            # programs; a successor trainer in the same process must
+            # re-earn it (its first burst compile is legitimate).
+            self.watchdog.clear_steady("train/")
         if self.telemetry is not None:
             self.telemetry.close()
         self.pool.close()

@@ -26,12 +26,17 @@ action with an explicit PRNG key.
 
 from __future__ import annotations
 
+import logging
 import threading
 import typing as t
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from torch_actor_critic_tpu.diagnostics.watchdog import get_watchdog
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["PolicyEngine", "default_buckets"]
 
@@ -127,6 +132,19 @@ class PolicyEngine:
         self._trace_names = {
             b: f"serve/forward[b{b}]" for b in self.buckets
         }
+        # Compile accounting (docs/OBSERVABILITY.md recompile
+        # watchdog): per-bucket warmup vs LIVE compile counts — a
+        # silently-recompiling bucket was previously indistinguishable
+        # from a slow one. First-seen (bucket, deterministic) keys
+        # count here; the process-wide watchdog additionally attributes
+        # every real backend compile (including re-compiles of
+        # already-seen keys) to this engine's `serve/forward[bN]`
+        # source labels and flags post-steady ones as anomalies.
+        self._compile_counts: t.Dict[int, t.List[int]] = {}  # b -> [wrm, live]
+        self.compiles_total = 0
+        self._warmup_active = False
+        self._warmed = False
+        self._watchdog = get_watchdog().install()
 
     # ----------------------------------------------------------- buckets
 
@@ -144,6 +162,24 @@ class PolicyEngine:
         """The ``(bucket, deterministic)`` shapes traced so far — the
         jit-cache keys this engine has populated."""
         return frozenset(self._compiled)
+
+    def compile_stats(self) -> dict:
+        """Per-bucket warmup/live compile counts for ``/metrics``:
+        ``live`` must stay 0 in a healthy service — every compile
+        belongs in warmup, and a nonzero live count means a real
+        request paid a multi-second compile (the recompilation
+        watchdog logs the offending bucket as it happens)."""
+        with self._lock:
+            return {
+                "compiles_total": self.compiles_total,
+                "live_compiles": sum(
+                    c[1] for c in self._compile_counts.values()
+                ),
+                "buckets": {
+                    str(b): {"warmup": c[0], "live": c[1]}
+                    for b, c in sorted(self._compile_counts.items())
+                },
+            }
 
     # ----------------------------------------------------------- forward
 
@@ -169,7 +205,8 @@ class PolicyEngine:
         n = int(jax.tree_util.tree_leaves(obs)[0].shape[0])
         bucket = self.bucket_for(n)
         padded = self._pad(obs, n, bucket)
-        with jax.profiler.TraceAnnotation(self._trace_names[bucket]):
+        with self._watchdog.source(self._trace_names[bucket]), \
+                jax.profiler.TraceAnnotation(self._trace_names[bucket]):
             if deterministic:
                 out = self._fwd[True](params, padded)
             else:
@@ -177,7 +214,21 @@ class PolicyEngine:
                     raise ValueError("sampled serving needs a PRNG key")
                 out = self._fwd[False](params, padded, key)
         with self._lock:
-            self._compiled.add((bucket, bool(deterministic)))
+            key_ = (bucket, bool(deterministic))
+            if key_ not in self._compiled:
+                self._compiled.add(key_)
+                counts = self._compile_counts.setdefault(bucket, [0, 0])
+                live = not self._warmup_active
+                counts[1 if live else 0] += 1
+                self.compiles_total += 1
+                if live and self._warmed:
+                    logger.warning(
+                        "serving bucket %d (deterministic=%s) compiled "
+                        "OUTSIDE warmup — a live request paid the "
+                        "compile; add the bucket to warmup or check the "
+                        "bucket ladder (docs/OBSERVABILITY.md)",
+                        bucket, deterministic,
+                    )
         return np.asarray(out)[:n]
 
     # ------------------------------------------------------------ warmup
@@ -190,20 +241,31 @@ class PolicyEngine:
     ) -> t.List[t.Tuple[int, bool]]:
         """Trace + compile every ``(bucket, deterministic)`` program up
         front so no live request ever pays a compile. Returns the list
-        of shapes warmed."""
+        of shapes warmed. Compiles in here count as ``warmup`` in
+        :meth:`compile_stats` and are ``expected`` to the recompilation
+        watchdog (a slot registered after the serving plane went steady
+        must not flag its own warmup as anomalies)."""
         warmed = []
         key = jax.random.key(0)
-        for bucket in (buckets or self.buckets):
-            zero_obs = jax.tree_util.tree_map(
-                lambda s: np.zeros((bucket,) + tuple(s.shape), s.dtype),
-                self.obs_spec,
-            )
-            for det in (True,) if deterministic_only else (True, False):
-                if det:
-                    sub = None
-                else:
-                    key, sub = jax.random.split(key)
-                out = self.act(params, zero_obs, sub, deterministic=det)
-                warmed.append((bucket, det))
-            del out
+        self._warmup_active = True
+        try:
+            with self._watchdog.expected():
+                for bucket in (buckets or self.buckets):
+                    zero_obs = jax.tree_util.tree_map(
+                        lambda s: np.zeros(
+                            (bucket,) + tuple(s.shape), s.dtype
+                        ),
+                        self.obs_spec,
+                    )
+                    for det in (True,) if deterministic_only else (True, False):
+                        if det:
+                            sub = None
+                        else:
+                            key, sub = jax.random.split(key)
+                        out = self.act(params, zero_obs, sub, deterministic=det)
+                        warmed.append((bucket, det))
+                    del out
+        finally:
+            self._warmup_active = False
+            self._warmed = True
         return warmed

@@ -154,6 +154,21 @@ class ModelRegistry:
             }
         return out
 
+    def compile_stats(self) -> dict:
+        """Service-wide jit-compile accounting: total + per-slot,
+        per-bucket warmup/live breakdown (``/metrics`` feeds the
+        recompilation watchdog's view with this; a nonzero
+        ``live_compiles`` is the silently-recompiling-bucket signal —
+        docs/OBSERVABILITY.md)."""
+        with self._lock:
+            items = list(self._slots.items())
+        slots = {name: slot.engine.compile_stats() for name, slot in items}
+        return {
+            "compiles_total": sum(s["compiles_total"] for s in slots.values()),
+            "live_compiles": sum(s["live_compiles"] for s in slots.values()),
+            "slots": slots,
+        }
+
     # --------------------------------------------------------- hot reload
 
     def swap(self, name: str, params, epoch: int | None = None) -> int:

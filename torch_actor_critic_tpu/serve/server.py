@@ -32,6 +32,7 @@ import jax
 import numpy as np
 
 from torch_actor_critic_tpu.core.types import MultiObservation
+from torch_actor_critic_tpu.diagnostics.watchdog import get_watchdog as _watchdog
 from torch_actor_critic_tpu.serve.batcher import ActResult, MicroBatcher
 from torch_actor_critic_tpu.serve.metrics import ServeMetrics
 from torch_actor_critic_tpu.serve.registry import ModelRegistry
@@ -164,6 +165,16 @@ class PolicyServer:
                     })
                 elif self.path == "/metrics":
                     snap = server.metrics.snapshot()
+                    # Compile accounting + the process-wide watchdog
+                    # view (docs/OBSERVABILITY.md): `compiles_total` /
+                    # per-slot bucket breakdown answer "did a live
+                    # request pay a compile", `xla` carries source-
+                    # attributed counts and steady-state anomalies.
+                    comp = server.registry.compile_stats()
+                    snap["compiles_total"] = comp["compiles_total"]
+                    snap["live_compiles"] = comp["live_compiles"]
+                    snap["compiles"] = comp["slots"]
+                    snap["xla"] = _watchdog().snapshot()
                     if server.extra_snapshot is not None:
                         try:
                             snap.update(server.extra_snapshot())
@@ -251,6 +262,10 @@ class PolicyServer:
 
     def start(self):
         """Serve on a background daemon thread (tests, smoke)."""
+        # Registered slots warmed up before start; from here on any
+        # serving-bucket compile is a steady-state anomaly (slots that
+        # register later run their warmup as `expected`).
+        _watchdog().install().mark_steady("serve/")
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="policy-http", daemon=True
         )
@@ -259,6 +274,7 @@ class PolicyServer:
 
     def serve_forever(self):
         """Block serving until interrupted (the CLI path)."""
+        _watchdog().install().mark_steady("serve/")
         try:
             self._httpd.serve_forever()
         except KeyboardInterrupt:  # pragma: no cover — operator stop
@@ -267,6 +283,7 @@ class PolicyServer:
             self.close()
 
     def close(self):
+        _watchdog().clear_steady("serve/")
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:

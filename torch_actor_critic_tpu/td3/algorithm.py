@@ -29,8 +29,13 @@ from flax import linen as nn
 
 from torch_actor_critic_tpu.ops.augment import augment_batch
 from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
+from torch_actor_critic_tpu.diagnostics import ingraph as diag
 from torch_actor_critic_tpu.ops.polyak import polyak_update
-from torch_actor_critic_tpu.sac.algorithm import Metrics, run_update_burst
+from torch_actor_critic_tpu.sac.algorithm import (
+    Metrics,
+    _shared_diagnostics,
+    run_update_burst,
+)
 from torch_actor_critic_tpu.td3 import losses
 from torch_actor_critic_tpu.utils.config import SACConfig
 
@@ -125,8 +130,13 @@ class TD3:
         The actor gradient is computed (and ``pmean``-averaged) every
         step but applied only on the delayed cadence — see the module
         docstring for why this beats ``lax.cond`` under ``shard_map``.
+
+        Tier-gated diagnostics mirror the SAC learner's (same keys,
+        same reductions — sac/algorithm.py), so the shared burst and
+        the Trainer's epoch aggregation treat both algorithms alike.
         """
         cfg = self.config
+        tier = cfg.diagnostics
         if cfg.frame_augment != "none":
             rng, key_q, key_aug = jax.random.split(state.rng, 3)
             batch = augment_batch(
@@ -153,13 +163,23 @@ class TD3:
             noise_clip=cfg.noise_clip,
             gamma=cfg.gamma,
             reward_scale=cfg.reward_scale,
+            diagnostics=tier != "off",
         )
+        diag_q = q_aux.pop("diag_q", None)
+        diag_backup = q_aux.pop("diag_backup", None)
+        diag_metrics: Metrics = {}
+        if tier != "off":
+            diag_metrics["diag/grad_norm_q"] = diag.global_norm(q_grads)
         if axis_name is not None:
             q_grads = jax.lax.pmean(q_grads, axis_name)
         q_updates, q_opt_state = self.q_tx.update(
             q_grads, state.q_opt_state, state.critic_params
         )
         critic_params = optax.apply_updates(state.critic_params, q_updates)
+        if tier != "off":
+            diag_metrics["diag/update_ratio_q"] = diag.norm_ratio(
+                q_updates, state.critic_params
+            )
 
         # --- delayed policy + target updates ---
         # step is 0-based pre-increment: delay=d applies the policy on
@@ -175,13 +195,23 @@ class TD3:
             critic_apply=self._critic_apply,
             critic_params=critic_params,
             batch=batch,
+            diagnostics=tier != "off",
         )
+        diag_pi = pi_aux.pop("diag_pi", None)
+        if tier != "off":
+            diag_metrics["diag/grad_norm_pi"] = diag.global_norm(pi_grads)
         if axis_name is not None:
             pi_grads = jax.lax.pmean(pi_grads, axis_name)
         pi_updates, pi_opt_new = self.pi_tx.update(
             pi_grads, state.pi_opt_state, state.actor_params
         )
         actor_new = optax.apply_updates(state.actor_params, pi_updates)
+        if tier != "off":
+            # The ratio reports the CANDIDATE step; on skipped
+            # (delayed) steps the applied update is zero by selection.
+            diag_metrics["diag/update_ratio_pi"] = diag.norm_ratio(
+                pi_updates, state.actor_params
+            )
 
         actor_params = _select_tree(do_pi, actor_new, state.actor_params)
         pi_opt_state = _select_tree(do_pi, pi_opt_new, state.pi_opt_state)
@@ -214,6 +244,14 @@ class TD3:
             **q_aux,
             **pi_aux,
         }
+        if tier != "off":
+            metrics.update(diag_metrics)
+            metrics.update(
+                _shared_diagnostics(
+                    cfg, loss_q, loss_pi, diag_q, diag_backup, diag_pi,
+                    self.act_limit,
+                )
+            )
         return new_state, metrics
 
     # --------------------------------------------------------------- burst

@@ -33,6 +33,7 @@ def critic_loss(
     noise_clip: float,
     gamma: float,
     reward_scale: float,
+    diagnostics: bool = False,
 ) -> t.Tuple[jax.Array, t.Dict[str, jax.Array]]:
     """Twin-critic Bellman MSE with target-policy smoothing.
 
@@ -64,6 +65,11 @@ def critic_loss(
     q = critic_apply(critic_params, batch.states, batch.actions)  # (num_qs, B)
     loss = jnp.sum(jnp.mean((q - backup[None, :]) ** 2, axis=-1))
     aux = {"q_mean": jnp.mean(q), "backup_mean": jnp.mean(backup)}
+    if diagnostics:
+        # Raw surfaces for the in-graph Q/TD reductions (popped by the
+        # learner before metrics; same contract as the SAC loss).
+        aux["diag_q"] = jax.lax.stop_gradient(q)
+        aux["diag_backup"] = backup
     return loss, aux
 
 
@@ -74,6 +80,7 @@ def actor_loss(
     critic_apply: t.Callable,
     critic_params: t.Any,
     batch: Batch,
+    diagnostics: bool = False,
 ) -> t.Tuple[jax.Array, t.Dict[str, jax.Array]]:
     """Deterministic policy gradient loss: ``-mean(Q_1(s, pi(s)))``.
 
@@ -88,4 +95,6 @@ def actor_loss(
     q_pi = critic_apply(critic_params, batch.states, pi)  # (num_qs, B)
     loss = -jnp.mean(q_pi[0])
     aux = {"q_pi_mean": jnp.mean(q_pi[0])}
+    if diagnostics:
+        aux["diag_pi"] = jax.lax.stop_gradient(pi)
     return loss, aux

@@ -23,7 +23,15 @@ from __future__ import annotations
 import math
 import typing as t
 
-__all__ = ["FixedBucketHistogram"]
+__all__ = ["FixedBucketHistogram", "geometric_bucket_count"]
+
+
+def geometric_bucket_count(lo: float, hi: float, growth: float) -> int:
+    """Number of geometric (interior) buckets covering ``[lo, hi)`` at
+    ratio ``growth`` — shared with the in-graph TD-error histogram
+    (:mod:`torch_actor_critic_tpu.diagnostics.ingraph`) so the device
+    counts vector and the host merge target always agree on length."""
+    return int(math.ceil((math.log(hi) - math.log(lo)) / math.log(growth)))
 
 
 class FixedBucketHistogram:
@@ -50,7 +58,7 @@ class FixedBucketHistogram:
         self._lo = float(lo)
         self._log_lo = math.log(lo)
         self._log_growth = math.log(growth)
-        n = int(math.ceil((math.log(hi) - self._log_lo) / self._log_growth))
+        n = geometric_bucket_count(lo, hi, growth)
         # index 0 = underflow (< lo), 1..n = geometric, n+1 = overflow.
         self._counts = [0] * (n + 2)
         self._n = n
@@ -59,7 +67,48 @@ class FixedBucketHistogram:
         self.min = math.inf
         self.max = 0.0
 
+    @property
+    def n_buckets(self) -> int:
+        """Geometric (interior) bucket count; the full counts vector is
+        ``n_buckets + 2`` (underflow + overflow)."""
+        return self._n
+
     # ------------------------------------------------------------ recording
+
+    def merge_counts(
+        self,
+        counts: t.Sequence[int],
+        total: float = 0.0,
+        vmin: float = math.inf,
+        vmax: float = 0.0,
+    ) -> None:
+        """Fold a pre-bucketed counts vector into this histogram — the
+        host-side half of the in-graph TD-error histogram
+        (docs/OBSERVABILITY.md "Learning-health diagnostics"): the
+        device reduces samples to a ``n_buckets + 2`` int vector under
+        the SAME bucket spec (lo/growth/n), and this merge keeps the
+        one-estimator-one-schema contract without ever materializing
+        the raw samples host-side. ``total``/``vmin``/``vmax`` carry the
+        exact side statistics the device reduced alongside the counts
+        (defaults leave them untouched for count-only merges)."""
+        if len(counts) != len(self._counts):
+            raise ValueError(
+                f"counts vector of length {len(counts)} does not match "
+                f"this histogram's {len(self._counts)} buckets — merge "
+                "requires an identical (lo, hi, growth) bucket spec"
+            )
+        merged = 0
+        for i, c in enumerate(counts):
+            c = int(c)
+            self._counts[i] += c
+            merged += c
+        self.count += merged
+        self.total += float(total)
+        if merged:
+            if vmin < self.min:
+                self.min = float(vmin)
+            if vmax > self.max:
+                self.max = float(vmax)
 
     def record(self, value: float) -> None:
         v = float(value)
@@ -119,18 +168,23 @@ class FixedBucketHistogram:
 
     # ------------------------------------------------------------- export
 
-    def snapshot(self, prefix: str = "", round_to: int = 3) -> dict:
+    def snapshot(
+        self, prefix: str = "", round_to: int = 3, unit: str = "ms"
+    ) -> dict:
         """``/metrics``-style keys: count/mean/p50/p95/p99/max (+prefix).
-        Percentile keys are present only when samples exist."""
+        Percentile keys are present only when samples exist. ``unit``
+        names the value suffix (``"ms"`` for latencies; pass ``""`` for
+        unitless quantities like TD-error magnitudes)."""
+        sfx = f"_{unit}" if unit else ""
         out: dict = {f"{prefix}count": self.count}
         if self.count:
             p50, p95, p99 = self.percentiles((50, 95, 99))
             out.update({
-                f"{prefix}mean_ms": round(self.mean, round_to),
-                f"{prefix}p50_ms": round(p50, round_to),
-                f"{prefix}p95_ms": round(p95, round_to),
-                f"{prefix}p99_ms": round(p99, round_to),
-                f"{prefix}max_ms": round(self.max, round_to),
+                f"{prefix}mean{sfx}": round(self.mean, round_to),
+                f"{prefix}p50{sfx}": round(p50, round_to),
+                f"{prefix}p95{sfx}": round(p95, round_to),
+                f"{prefix}p99{sfx}": round(p99, round_to),
+                f"{prefix}max{sfx}": round(self.max, round_to),
             })
         return out
 

@@ -185,6 +185,14 @@ def main(argv=None):
                 "host-visible phases to span — use --profile for a "
                 "whole-run trace instead"
             )
+        if config.diagnostics != "off":
+            logger.warning(
+                "--diagnostics is a host-Trainer feature; the fused "
+                "on-device loop reports loss means only, so the "
+                "in-graph diagnostic reductions would be dead code "
+                "(XLA eliminates them) — running effectively at "
+                "diagnostics=off"
+            )
         from torch_actor_critic_tpu.sac.ondevice import train_on_device
 
         logger.info(

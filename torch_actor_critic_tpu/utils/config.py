@@ -194,6 +194,22 @@ class SACConfig:
     # Off by default: the disabled hot path carries zero telemetry work
     # (bench.py `telemetry_overhead` pins the enabled cost at <5%).
     telemetry: bool = False
+    # Learning-health diagnostics tier (diagnostics/,
+    # docs/OBSERVABILITY.md "Learning-health diagnostics"): in-graph
+    # gradient/Q/entropy reductions fused into the update burst.
+    #   "off"   — parity default: compiled graph, metric keys and jit
+    #             cache bitwise identical to an uninstrumented build;
+    #   "light" — scalar diagnostics (grad global-norms, update-to-
+    #             param ratios, Q stats, action saturation, per-burst
+    #             loss maxima) + dp replica-skew + the recompilation
+    #             watchdog; bench.py `diagnostics_overhead` holds this
+    #             within the 5% bar on the CPU smoke config;
+    #   "full"  — light + the on-device fixed-bucket TD-error
+    #             histogram (merged host-side into the telemetry
+    #             histogram schema).
+    # The tier is read at trace time, so it is part of the compiled
+    # program's identity — flipping it can never alias a cache entry.
+    diagnostics: str = "off"
 
     def __post_init__(self):
         if not (len(self.filters) == len(self.kernel_sizes) == len(self.strides)):
@@ -259,6 +275,11 @@ class SACConfig:
                 "the 'independent' seeds through their input scaling; "
                 "per-member normalizers are not wired yet — run the "
                 "population unnormalized"
+            )
+        if self.diagnostics not in ("off", "light", "full"):
+            raise ValueError(
+                f"diagnostics must be 'off', 'light' or 'full', got "
+                f"{self.diagnostics!r}"
             )
         if self.max_rollbacks < 0:
             raise ValueError(
