@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke
+.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -65,6 +65,13 @@ diag-smoke:
 # worker) driven through a real Trainer (docs/RESILIENCE.md).
 fault-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q -m "not slow"
+
+# Serving overload chaos: flood an in-process server past capacity
+# with injected engine faults — queue stays bounded, breaker trips and
+# recovers, NaN-checkpoint reload is rejected, drain answers every
+# accepted request (docs/SERVING.md "Overload & degradation").
+chaos-smoke:
+	JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

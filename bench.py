@@ -1193,6 +1193,154 @@ def bench_serving(budget_s=180.0, n_threads=16, requests_per_thread=150):
     return out
 
 
+def bench_overload(budget_s=180.0, capacity=64):
+    """Overload behavior at 2x capacity (docs/SERVING.md "Overload &
+    degradation"): calibrate the stack's saturated service rate, then
+    offer twice that for a fixed window with a bounded queue and
+    per-request deadlines, and record what admission control did —
+    goodput (accepted AND answered per second), shed rate and
+    breakdown, queue-bound compliance, and tail latency under
+    overload. The acceptance story: goodput should hold near the
+    calibrated service rate while the excess is rejected with
+    structured 429/503s, instead of every request getting slower
+    forever (the unbounded-queue failure mode this layer replaced)."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torch_actor_critic_tpu.models import Actor
+    from torch_actor_critic_tpu.resilience.faultinject import flood
+    from torch_actor_critic_tpu.serve import (
+        MicroBatcher,
+        ModelRegistry,
+        ShedError,
+    )
+
+    t_start = time.time()
+    actor = Actor(act_dim=ACT_DIM, hidden_sizes=HIDDEN)
+    params = actor.init(
+        jax.random.key(0), jnp.zeros((OBS_DIM,)), jax.random.key(1)
+    )
+    obs_spec = jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32)
+    registry = ModelRegistry()
+    max_batch = 64
+    registry.register(
+        "default", actor, obs_spec, params=params, max_batch=max_batch,
+    )
+    obs = np.ones((OBS_DIM,), np.float32)
+    out = {
+        "capacity": capacity, "max_batch": max_batch,
+        "backend": jax.default_backend(),
+    }
+
+    with MicroBatcher(
+        registry, max_batch=max_batch, max_wait_ms=2.0, capacity=capacity
+    ) as mb:
+        # Calibration: closed-loop saturation from a small herd gives
+        # the achievable service rate (requests/s) for 1-row requests.
+        cal_stop = threading.Event()
+        cal_done = [0] * 8
+
+        def cal_worker(i):
+            while not cal_stop.is_set():
+                mb.act(obs, timeout=30.0)
+                cal_done[i] += 1
+
+        cal_threads = [
+            threading.Thread(target=cal_worker, args=(i,))
+            for i in range(len(cal_done))
+        ]
+        t0 = time.perf_counter()
+        for th in cal_threads:
+            th.start()
+        cal_window = min(10.0, budget_s / 6)
+        time.sleep(cal_window)
+        cal_stop.set()
+        for th in cal_threads:
+            th.join(timeout=30.0)
+        service_rate = sum(cal_done) / (time.perf_counter() - t0)
+        out["service_rate_rps"] = round(service_rate, 1)
+
+        # Overload window: offer 2x the calibrated rate, paced
+        # open-loop across a thread herd, each request carrying a
+        # deadline so the infeasible/expired paths are exercised too.
+        offered_rate = 2.0 * max(service_rate, 1.0)
+        n_threads = 16
+        window_s = min(20.0, max(5.0, budget_s - (time.time() - t_start) - 30))
+        interval = n_threads / offered_rate
+        futures, sheds = [], []
+        flood_lock = threading.Lock()
+        depth_max = [0]
+        stop = threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                depth_max[0] = max(depth_max[0], mb.queue_depth())
+                time.sleep(0.002)
+
+        def offer_worker(i):
+            t_next = time.perf_counter() + (i / n_threads) * interval
+            t_end = time.perf_counter() + window_s
+            local_f, local_s = [], []
+            while time.perf_counter() < t_end:
+                delay = t_next - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                t_next += interval
+                f, s = flood(mb.submit, obs, 1, deadline_s=0.5)
+                local_f += f
+                local_s += s
+            with flood_lock:
+                futures.extend(local_f)
+                sheds.extend(local_s)
+
+        smp = threading.Thread(target=sampler, daemon=True)
+        smp.start()
+        workers = [
+            threading.Thread(target=offer_worker, args=(i,))
+            for i in range(n_threads)
+        ]
+        t0 = time.perf_counter()
+        for th in workers:
+            th.start()
+        for th in workers:
+            th.join(timeout=window_s + 60)
+        answered, expired = 0, 0
+        for f in futures:
+            try:
+                f.result(timeout=60)
+                answered += 1
+            except ShedError:
+                expired += 1
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        snap = mb.metrics.snapshot()
+
+    offered = len(futures) + len(sheds)
+    out.update({
+        "offered_rate_rps": round(offered / elapsed, 1),
+        "target_offered_rate_rps": round(offered_rate, 1),
+        "goodput_rps": round(answered / elapsed, 1),
+        "answered": answered,
+        "shed_submit": len(sheds),
+        "shed_expired": expired,
+        "shed_fraction": round((len(sheds) + expired) / max(offered, 1), 4),
+        "shed_by_reason": snap["shed_by_reason"],
+        "max_queue_depth": depth_max[0],
+        "queue_bound_held": depth_max[0] <= capacity,
+        "p50_ms": snap.get("p50_ms"),
+        "p99_ms": snap.get("p99_ms"),
+    })
+    registry.close()
+    log(f"overload: offered {out['offered_rate_rps']} rps (2x capacity "
+        f"{out['service_rate_rps']}), goodput {out['goodput_rps']} rps, "
+        f"shed {out['shed_fraction'] * 100:.1f}%, max queue depth "
+        f"{out['max_queue_depth']}/{capacity}")
+    return out
+
+
 def bench_telemetry_overhead(budget_s=420.0):
     """Telemetry cost (docs/OBSERVABILITY.md zero-overhead contract):
     steady-state Trainer throughput with telemetry off vs on (full
@@ -1437,6 +1585,7 @@ _STAGES = {
     "population": lambda: {"population": bench_population()},
     "visual": lambda: {"visual": bench_visual()},
     "serving": lambda: {"serving": bench_serving()},
+    "overload": lambda: {"overload": bench_overload()},
     "host_envs": lambda: {"host_envs": bench_host_envs()},
     "telemetry_overhead": lambda: {
         "telemetry_overhead": bench_telemetry_overhead()
@@ -1609,6 +1758,18 @@ def main():
     )
     if res and "error" in res:
         diagnostics.append({"serving_stage_error": res.pop("error")})
+    if res:
+        out.update(res)
+
+    # 5a''. Overload containment (docs/SERVING.md "Overload &
+    # degradation"): flood the same stack at 2x its calibrated
+    # capacity with a bounded queue — records goodput vs shed rate and
+    # that the queue bound held. Same backend as the serving stage.
+    res = run_stage_subprocess(
+        "overload", 420, diagnostics, platform=serving_platform
+    )
+    if res and "error" in res:
+        diagnostics.append({"overload_stage_error": res.pop("error")})
     if res:
         out.update(res)
 

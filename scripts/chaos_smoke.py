@@ -1,0 +1,241 @@
+"""Chaos smoke for the serving plane (make chaos-smoke, CPU, ~1 min).
+
+Drives the three overload-containment claims of docs/SERVING.md
+"Overload & degradation" through a REAL in-process server — the same
+registry/batcher/engine stack production runs, with faults injected by
+resilience/faultinject.py:
+
+1. **Flood past capacity**: a closed-loop client herd floods the
+   batcher at well past service rate (the engine is slowed to make
+   CPU forwards the bottleneck). Asserts the queue NEVER exceeds its
+   configured bound, every ACCEPTED request is answered, and every
+   rejected submit carried a structured reason + retry hint.
+2. **Breaker trip + recovery**: NaN params (in-graph finiteness check)
+   trip the slot breaker; requests fail fast while open; after the
+   cooldown a half-open probe against restored-good params closes it
+   and traffic resumes.
+3. **Validated reload**: a NaN-corrupted checkpoint epoch is rejected
+   by the all-finite sentinel — the slot keeps serving its last-good
+   generation — and a clean drain answers everything still queued.
+
+Exits nonzero on any violated invariant; prints a one-line JSON
+summary for CI logs.
+"""
+
+import json
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torch_actor_critic_tpu.models import Actor, DoubleCritic
+    from torch_actor_critic_tpu.resilience.faultinject import (
+        corrupt_checkpoint,
+        flood,
+        nan_params,
+    )
+    from torch_actor_critic_tpu.sac import SAC
+    from torch_actor_critic_tpu.serve import (
+        BreakerOpenError,
+        CircuitBreaker,
+        MicroBatcher,
+        ModelRegistry,
+        NonFiniteActionError,
+        ShedError,
+    )
+    from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
+    from torch_actor_critic_tpu.utils.config import SACConfig
+
+    OBS_DIM, ACT_DIM = 17, 6
+    CAPACITY = 16
+    obs = np.ones((OBS_DIM,), np.float32)
+    summary = {}
+
+    actor = Actor(act_dim=ACT_DIM, hidden_sizes=(32, 32))
+    good_params = actor.init(
+        jax.random.key(0), jnp.zeros((OBS_DIM,)), jax.random.key(1)
+    )
+    spec = jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32)
+    breaker = CircuitBreaker(fail_threshold=3, cooldown_s=0.3)
+    reg = ModelRegistry()
+    reg.register(
+        "default", actor, spec, params=good_params, max_batch=8,
+        breaker=breaker,
+    )
+
+    # Slow the engine so a tiny CPU flood is a REAL overload (service
+    # rate ~ max_batch / 5ms) without needing thousands of threads.
+    engine, _, _ = reg.acquire("default")
+    real_act = engine.act
+
+    def slow_act(*args, **kwargs):
+        time.sleep(0.005)
+        return real_act(*args, **kwargs)
+
+    engine.act = slow_act
+
+    with MicroBatcher(
+        reg, max_batch=8, max_wait_ms=1.0, capacity=CAPACITY
+    ) as mb:
+        # ---------------------------------------------- 1. flood
+        depth_samples = []
+        stop_sampler = threading.Event()
+
+        def sampler():
+            while not stop_sampler.is_set():
+                depth_samples.append(mb.queue_depth())
+                time.sleep(0.002)
+
+        smp = threading.Thread(target=sampler, daemon=True)
+        smp.start()
+        futures, sheds = [], []
+        flood_lock = threading.Lock()
+
+        def flooder():
+            f, s = flood(mb.submit, obs, 200)
+            with flood_lock:
+                futures.extend(f)
+                sheds.extend(s)
+
+        herd = [threading.Thread(target=flooder) for _ in range(8)]
+        t0 = time.perf_counter()
+        for th in herd:
+            th.start()
+        for th in herd:
+            th.join(timeout=120.0)
+        answered = 0
+        for f in futures:
+            res = f.result(timeout=120.0)  # raises if dropped/errored
+            assert res.action.shape == (ACT_DIM,)
+            answered += 1
+        flood_s = time.perf_counter() - t0
+        stop_sampler.set()
+        smp.join(timeout=10.0)
+        offered = len(futures) + len(sheds)
+        assert offered == 8 * 200, offered
+        assert len(sheds) > 0, "flood never exceeded capacity"
+        assert all(e.reason == "queue_full" for e in sheds)
+        assert all(e.retry_after_s > 0 for e in sheds)
+        max_depth = max(depth_samples) if depth_samples else 0
+        assert max_depth <= CAPACITY, (
+            f"queue depth {max_depth} exceeded bound {CAPACITY}"
+        )
+        summary["flood"] = {
+            "offered": offered,
+            "accepted_and_answered": answered,
+            "shed": len(sheds),
+            "max_queue_depth": max_depth,
+            "capacity": CAPACITY,
+            "goodput_rps": round(answered / flood_s, 1),
+        }
+
+        # --------------------------------------- 2. breaker cycle
+        reg.swap("default", nan_params(good_params), validate=False)
+        failures = 0
+        while breaker.state != "open":
+            assert failures < 50, "breaker never tripped"
+            try:
+                mb.act(obs, timeout=30.0)
+            except NonFiniteActionError:
+                failures += 1
+            except BreakerOpenError:
+                break
+        assert breaker.trips_total >= 1
+        # open -> fail fast, zero engine work
+        try:
+            mb.act(obs, timeout=30.0)
+            raise AssertionError("open breaker admitted a request")
+        except (BreakerOpenError, NonFiniteActionError):
+            pass
+        # heal the engine, wait out the cooldown, probe recovers
+        reg.swap("default", good_params)
+        deadline = time.time() + 30.0
+        while True:
+            assert time.time() < deadline, "breaker never recovered"
+            try:
+                res = mb.act(obs, timeout=30.0)
+                break
+            except (BreakerOpenError, NonFiniteActionError):
+                time.sleep(0.05)
+        assert breaker.state == "closed"
+        assert res.action.shape == (ACT_DIM,)
+        summary["breaker"] = {
+            "failures_to_trip": failures,
+            "trips_total": breaker.trips_total,
+            "probes_total": breaker.probes_total,
+            "final_state": breaker.state,
+            "events": len(reg.breaker_events()),
+        }
+
+        # ------------------------------- 3. validated hot-reload
+        with tempfile.TemporaryDirectory() as tmp:
+            cfg = SACConfig(hidden_sizes=(32, 32))
+            sac = SAC(
+                cfg, Actor(act_dim=ACT_DIM, hidden_sizes=(32, 32)),
+                DoubleCritic(hidden_sizes=(32, 32)), ACT_DIM,
+            )
+            ck = Checkpointer(tmp, save_buffer=False)
+            ck.save(
+                0, sac.init_state(jax.random.key(2), jnp.zeros((OBS_DIM,))),
+                extra={"config": cfg.to_json()}, wait=True,
+            )
+            ck.close()
+            reg.register(
+                "reloadable", Actor(act_dim=ACT_DIM, hidden_sizes=(32, 32)),
+                spec, ckpt_dir=str(tmp), max_batch=8, warmup=False,
+            )
+            # The trainer then "writes" a NaN-poisoned epoch 1 — a
+            # structurally valid checkpoint only the sentinel can
+            # catch. Reload must reject it and keep the last-good
+            # generation serving.
+            ck = Checkpointer(tmp, save_buffer=False)
+            ck.save(
+                1, sac.init_state(jax.random.key(3), jnp.zeros((OBS_DIM,))),
+                extra={"config": cfg.to_json()}, wait=True,
+            )
+            ck.close()
+            corrupt_checkpoint(tmp, 1, mode="nan-params")
+            before_gen = reg.slots()["reloadable"]["generation"]
+            out = reg.reload("reloadable")
+            assert out["reloadable"]["status"] == "rejected", out
+            assert out["reloadable"]["reloaded"] is False
+            assert reg.slots()["reloadable"]["generation"] == before_gen
+            res = mb.act(obs, slot="reloadable", timeout=30.0)
+            assert np.isfinite(res.action).all()
+            summary["reload"] = {
+                "status": out["reloadable"]["status"],
+                "generation_unchanged": True,
+            }
+
+        # ---------------------------------------------- 4. drain
+        tail = [mb.submit(obs) for _ in range(CAPACITY // 2)]
+        mb.close()  # stop admissions + flush: the drain core
+        for f in tail:
+            assert f.result(timeout=30.0).action.shape == (ACT_DIM,)
+        try:
+            mb.submit(obs)
+            raise AssertionError("closed batcher accepted a request")
+        except ShedError as e:
+            assert e.reason == "draining"
+        snap = mb.metrics.snapshot()
+        summary["drain"] = {
+            "flushed": len(tail),
+            "responses_total": snap["responses_total"],
+            "sheds_total": snap["sheds_total"],
+        }
+
+    reg.close()
+    print("CHAOS-SMOKE OK " + json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,14 @@ Serving knobs: --port (0 = ephemeral, printed at startup), --max-batch,
 (comma list overriding the power-of-two ladder), --poll-interval
 (checkpoint hot-reload cadence in seconds; 0 disables).
 
+Overload & degradation knobs (docs/SERVING.md): --queue-capacity
+(admission bound; past it /act answers 429 + Retry-After),
+--breaker-threshold/--breaker-cooldown (consecutive engine failures
+before the slot trips open; seconds before a half-open probe),
+--reload-retries/--reload-retry-backoff (transient-IO retry for the
+hot-reload watcher), --drain-timeout (SIGTERM graceful-drain flush
+budget — admissions stop, accepted requests are answered, exit 0).
+
 Endpoints: POST /act, GET /healthz, GET /metrics, POST /reload.
 """
 
@@ -62,7 +70,32 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                           "stalled client frees its handler thread)")
     srv.add_argument("--act-timeout", type=float, default=30.0,
                      help="Max seconds to wait on the batcher before "
-                          "answering 503 + Retry-After")
+                          "answering 503 + Retry-After (also the "
+                          "request deadline: expired requests are "
+                          "purged, never forwarded)")
+    ovl = p.add_argument_group("overload & degradation")
+    ovl.add_argument("--queue-capacity", type=int, default=1024,
+                     help="Admission bound on queued requests; past it "
+                          "/act answers 429 + Retry-After instead of "
+                          "growing the queue")
+    ovl.add_argument("--breaker-threshold", type=int, default=5,
+                     help="Consecutive engine failures (incl. "
+                          "non-finite actions) before the slot's "
+                          "circuit breaker trips open")
+    ovl.add_argument("--breaker-cooldown", type=float, default=5.0,
+                     help="Seconds an open breaker waits before a "
+                          "half-open probe re-admits traffic")
+    ovl.add_argument("--reload-retries", type=int, default=1,
+                     help="Extra attempts (with backoff) for each "
+                          "slot's hot-reload IO before the poll "
+                          "reports an error")
+    ovl.add_argument("--reload-retry-backoff", type=float, default=0.5,
+                     help="Base backoff seconds between hot-reload "
+                          "retries (doubles per attempt)")
+    ovl.add_argument("--drain-timeout", type=float, default=30.0,
+                     help="SIGTERM graceful-drain flush budget in "
+                          "seconds (answer everything accepted, then "
+                          "exit 0)")
     return p.parse_args(argv)
 
 
@@ -135,17 +168,29 @@ def main(argv=None):
 
     honor_platform_env()
 
-    from torch_actor_critic_tpu.serve import ModelRegistry, PolicyServer
+    from torch_actor_critic_tpu.serve import (
+        CircuitBreaker,
+        ModelRegistry,
+        PolicyServer,
+        install_drain_handler,
+    )
 
     actor_def, obs_spec, ckpt_dir = _resolve_model(args)
     buckets = (
         [int(b) for b in args.buckets.split(",")] if args.buckets else None
     )
 
-    registry = ModelRegistry()
+    registry = ModelRegistry(
+        reload_retries=args.reload_retries,
+        reload_retry_backoff_s=args.reload_retry_backoff,
+    )
     info = registry.register(
         "default", actor_def, obs_spec,
         ckpt_dir=ckpt_dir, max_batch=args.max_batch, buckets=buckets,
+        breaker=CircuitBreaker(
+            fail_threshold=args.breaker_threshold,
+            cooldown_s=args.breaker_cooldown,
+        ),
     )
     logger.info("model loaded: %s", info)
     if args.poll_interval > 0:
@@ -157,7 +202,11 @@ def main(argv=None):
         seed=args.seed,
         request_timeout_s=args.request_timeout,
         act_timeout_s=args.act_timeout,
+        capacity=args.queue_capacity,
     )
+    # Rolling-restart contract: SIGTERM stops admissions, answers every
+    # accepted request, then serve_forever returns and we exit 0.
+    install_drain_handler(server, flush_timeout_s=args.drain_timeout)
     print(json.dumps({
         "serving": server.address, "slots": registry.slots(),
     }), flush=True)

@@ -20,12 +20,23 @@ on CPU:
 - **Checkpoint IO faults** — :func:`make_flaky` wraps any callable to
   fail its first N calls (transient-IO retry path);
   :func:`corrupt_checkpoint` damages an on-disk Orbax step the way an
-  interrupted async save does (missing items / truncated arrays),
-  exercising the fallback-to-previous-epoch path.
+  interrupted async save does (missing items / truncated arrays), or
+  NaN-poisons its parameters (``mode="nan-params"`` — the silent
+  corruption the serving reload sentinel must reject), exercising the
+  fallback-to-previous-epoch and last-good-generation paths.
+- **Serving-engine faults** — :class:`FaultyEngine` wraps a
+  :class:`~torch_actor_critic_tpu.serve.engine.PolicyEngine` and makes
+  scheduled forwards raise (the forward-failure trip path of the
+  circuit breaker); :func:`nan_params` NaN-poisons a params pytree so
+  the engine's own in-graph all-finite reduction fires (the
+  non-finite trip path); :func:`flood` fires a burst of requests past
+  service rate at a micro-batcher (the admission-control/queue-bound
+  path — ``scripts/chaos_smoke.py`` and ``tests/test_overload.py``).
 
 Injection is deliberately *compositional*: tests build a normal
 Trainer, then ``trainer.pool = FaultyEnvPool(trainer.pool, ...)`` —
-the trainer code under test is exactly the code production runs.
+the trainer code under test is exactly the code production runs; the
+serving tests likewise wrap real engines and flood real batchers.
 """
 
 from __future__ import annotations
@@ -40,9 +51,12 @@ import numpy as np
 
 __all__ = [
     "FaultyEnvPool",
+    "FaultyEngine",
     "kill_env_worker",
     "make_flaky",
     "corrupt_checkpoint",
+    "nan_params",
+    "flood",
 ]
 
 
@@ -122,6 +136,99 @@ class FaultyEnvPool:
         return getattr(self._pool, name)
 
 
+class FaultyEngine:
+    """Protocol-transparent :class:`PolicyEngine` wrapper with
+    scheduled forward failures — the engine-fault injector for the
+    circuit-breaker path.
+
+    Wraps a real engine (every attribute proxies through, so the
+    batcher cannot tell the difference) and makes the next ``n``
+    ``act`` calls raise. Register the wrapped slot, then::
+
+        faulty = FaultyEngine(registry._slots["default"].engine)
+        registry._slots["default"].engine = faulty      # tests only
+        faulty.fail_next(5)                             # trips breaker
+
+    Counting is on ``act`` calls on THIS wrapper, so tests can assert
+    exactly how many forwards the engine actually ran (e.g. that a
+    purged request never reached it).
+    """
+
+    def __init__(self, engine: t.Any):
+        self._engine = engine
+        self._fail_left = 0
+        self._exc_factory: t.Callable[[], BaseException] = lambda: (
+            RuntimeError("injected engine forward failure")
+        )
+        self.calls_total = 0
+        self.failures_injected = 0
+
+    def fail_next(
+        self,
+        n: int,
+        exc_factory: t.Callable[[], BaseException] | None = None,
+    ) -> "FaultyEngine":
+        """Make the next ``n`` forwards raise (cumulative with any
+        already scheduled)."""
+        self._fail_left += int(n)
+        if exc_factory is not None:
+            self._exc_factory = exc_factory
+        return self
+
+    def act(self, *args, **kwargs):
+        self.calls_total += 1
+        if self._fail_left > 0:
+            self._fail_left -= 1
+            self.failures_injected += 1
+            raise self._exc_factory()
+        return self._engine.act(*args, **kwargs)
+
+    def __getattr__(self, name: str):
+        return getattr(self._engine, name)
+
+
+def nan_params(params: t.Any, fraction_leaf: int = 0) -> t.Any:
+    """NaN-poison a params pytree: every float leaf (or just leaf index
+    ``fraction_leaf`` onward — one poisoned leaf is enough for the
+    sentinel) becomes all-NaN. The non-finite-output injector: swap the
+    result into a serving slot (``registry.swap(..., validate=False)``)
+    and the engine's in-graph all-finite reduction reports every
+    forward to the circuit breaker."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    out = []
+    for i, x in enumerate(leaves):
+        x = np.asarray(x)
+        if i >= fraction_leaf and np.issubdtype(x.dtype, np.floating):
+            x = np.full_like(x, np.nan)
+        out.append(x)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def flood(
+    submit: t.Callable[..., t.Any],
+    obs: t.Any,
+    n_requests: int,
+    **submit_kwargs,
+) -> t.Tuple[list, list]:
+    """Fire ``n_requests`` submits back-to-back (far past service
+    rate) and return ``(futures, shed_errors)`` — accepted requests'
+    futures versus the structured rejections admission control
+    answered instead of queueing. ``submit`` is typically
+    ``MicroBatcher.submit``; any exception that is not a rejection
+    propagates (a flood must not hide real bugs)."""
+    from torch_actor_critic_tpu.serve.admission import ShedError
+
+    futures, sheds = [], []
+    for _ in range(int(n_requests)):
+        try:
+            futures.append(submit(obs, **submit_kwargs))
+        except ShedError as e:
+            sheds.append(e)
+    return futures, sheds
+
+
 def kill_env_worker(pool, idx: int, join_timeout_s: float = 10.0) -> int:
     """SIGKILL worker ``idx`` of a :class:`ParallelEnvPool` and reap it.
 
@@ -169,7 +276,14 @@ def corrupt_checkpoint(
       earlier — the step is unreadable at probe time);
     - ``"truncate"``: zero-truncate every array file under
       ``train_state`` (partial flush: the structure exists, the bytes
-      do not).
+      do not);
+    - ``"nan-params"``: round-trip the step through Orbax with every
+      float leaf NaN-poisoned — a *structurally valid* checkpoint whose
+      parameters are garbage (corrupted host memory, a diverged run
+      checkpointed by a writer without the sentinel). Restores succeed;
+      only a finiteness check can catch it — exactly what the serving
+      reload sentinel must reject while keeping the last-good
+      generation (docs/SERVING.md "Overload & degradation").
 
     Returns the corrupted step directory.
     """
@@ -184,6 +298,31 @@ def corrupt_checkpoint(
         for f in (step_dir / "train_state").rglob("*"):
             if f.is_file():
                 f.write_bytes(b"")
+    elif mode == "nan-params":
+        import orbax.checkpoint as ocp
+
+        mgr = ocp.CheckpointManager(Path(directory).absolute())
+        try:
+            saved_items = set(mgr.item_metadata(epoch).keys())
+            args = {
+                k: (ocp.args.JsonRestore() if k == "meta"
+                    else ocp.args.StandardRestore())
+                for k in saved_items
+            }
+            out = dict(mgr.restore(epoch, args=ocp.args.Composite(**args)))
+            out["train_state"] = nan_params(out["train_state"])
+            save_args = {
+                k: (ocp.args.JsonSave(v) if k == "meta"
+                    else ocp.args.StandardSave(v))
+                for k, v in out.items()
+            }
+            mgr.delete(epoch)
+            mgr.save(
+                epoch, args=ocp.args.Composite(**save_args), force=True
+            )
+            mgr.wait_until_finished()
+        finally:
+            mgr.close()
     else:
         raise ValueError(f"unknown corruption mode {mode!r}")
     return step_dir

@@ -23,16 +23,31 @@ forward pass, and the TorchBeast server-side dynamic-batching pattern
   ``/metrics``, ``/reload``) plus the in-process
   :class:`~torch_actor_critic_tpu.serve.server.PolicyClient`.
 - :mod:`~torch_actor_critic_tpu.serve.metrics` — queue depth, batch
-  occupancy, request rate and latency percentiles.
+  occupancy, request rate, latency percentiles and shed accounting.
+- :mod:`~torch_actor_critic_tpu.serve.admission` /
+  :mod:`~torch_actor_critic_tpu.serve.breaker` — overload containment
+  (docs/SERVING.md "Overload & degradation"): bounded-queue admission
+  with deadline-aware shedding (structured
+  :class:`~torch_actor_critic_tpu.serve.admission.ShedError` → HTTP
+  429/503 + ``Retry-After``) and a per-slot engine circuit breaker
+  (consecutive failures / in-graph non-finite detection trip it; a
+  half-open probe re-admits traffic after cooldown).
 
 Entry point: ``python serve.py`` at the repo root (see docs/SERVING.md).
 """
 
+from torch_actor_critic_tpu.serve.admission import (  # noqa: F401
+    BreakerOpenError,
+    NonFiniteActionError,
+    ShedError,
+)
 from torch_actor_critic_tpu.serve.batcher import MicroBatcher  # noqa: F401
+from torch_actor_critic_tpu.serve.breaker import CircuitBreaker  # noqa: F401
 from torch_actor_critic_tpu.serve.engine import PolicyEngine  # noqa: F401
 from torch_actor_critic_tpu.serve.metrics import ServeMetrics  # noqa: F401
 from torch_actor_critic_tpu.serve.registry import ModelRegistry  # noqa: F401
 from torch_actor_critic_tpu.serve.server import (  # noqa: F401
     PolicyClient,
     PolicyServer,
+    install_drain_handler,
 )

@@ -26,6 +26,21 @@ Each response carries the model **generation** it was computed under
 captures ``(engine, params, generation)`` once per group, so a
 hot-reload swap mid-group simply means the group finishes on the old
 weights and the next group picks up the new ones.
+
+Admission control (docs/SERVING.md "Overload & degradation"): the
+queue is **bounded** (``capacity``) and every request may carry a
+deadline. Submit-time rejection — queue full, deadline provably
+infeasible at the measured service rate, draining, or the slot's
+circuit breaker open — raises a structured
+:class:`~torch_actor_critic_tpu.serve.admission.ShedError` instead of
+queueing work that cannot be served in time; requests whose deadline
+expires *while queued* are purged at group-collection time (futures
+failed, never dispatched), so the accelerator only ever runs live
+work. The circuit breaker
+(:mod:`~torch_actor_critic_tpu.serve.breaker`) is consulted once per
+group: open means the whole group fails fast with 503-semantics, and
+engine outcomes (success / raised / non-finite actions) feed back into
+it.
 """
 
 from __future__ import annotations
@@ -39,6 +54,10 @@ from concurrent.futures import Future
 import jax
 import numpy as np
 
+from torch_actor_critic_tpu.serve.admission import (
+    BreakerOpenError,
+    ShedError,
+)
 from torch_actor_critic_tpu.serve.metrics import ServeMetrics
 
 __all__ = ["MicroBatcher", "ActResult"]
@@ -53,15 +72,23 @@ class ActResult(t.NamedTuple):
 
 
 class _Request:
-    __slots__ = ("obs", "rows", "slot", "deterministic", "future", "t_enq")
+    __slots__ = (
+        "obs", "rows", "slot", "deterministic", "future", "t_enq",
+        "deadline",
+    )
 
-    def __init__(self, obs, rows, slot, deterministic):
+    def __init__(self, obs, rows, slot, deterministic, deadline_s=None):
         self.obs = obs
         self.rows = rows
         self.slot = slot
         self.deterministic = deterministic
         self.future: Future = Future()
         self.t_enq = time.perf_counter()
+        # Absolute perf_counter deadline; None = the caller will wait
+        # forever, so the request can never expire in the queue.
+        self.deadline = (
+            self.t_enq + deadline_s if deadline_s is not None else None
+        )
 
 
 class MicroBatcher:
@@ -72,7 +99,10 @@ class MicroBatcher:
     ``max_batch`` bounds rows per engine call; ``max_wait_ms`` bounds
     the queueing latency added to the OLDEST request in a group (a lone
     request never waits longer than the deadline). ``seed`` keys the
-    sampled-action PRNG stream.
+    sampled-action PRNG stream. ``capacity`` bounds the number of
+    QUEUED requests — the overload backstop: submit past it raises
+    :class:`~torch_actor_critic_tpu.serve.admission.ShedError`
+    (``queue_full``) instead of growing host memory without bound.
     """
 
     def __init__(
@@ -82,17 +112,26 @@ class MicroBatcher:
         max_wait_ms: float = 2.0,
         metrics: ServeMetrics | None = None,
         seed: int = 0,
+        capacity: int = 1024,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.registry = registry
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
+        self.capacity = int(capacity)
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self._key = jax.random.key(seed)
         self._queue: collections.deque[_Request] = collections.deque()
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
+        # Measured service rate (EMA of seconds per dispatched row),
+        # written by the dispatcher after each group, read under the
+        # lock by submit-time deadline-feasibility checks.
+        self._ema_row_s: float | None = None
+        self._ema_samples = 0
         self._running = True
         self._thread = threading.Thread(
             target=self._dispatch_loop, name="micro-batcher", daemon=True
@@ -106,14 +145,30 @@ class MicroBatcher:
         obs: t.Any,
         deterministic: bool = True,
         slot: str = "default",
+        deadline_s: float | None = None,
     ) -> Future:
         """Enqueue one request; returns a Future resolving to
         :class:`ActResult`. ``obs`` is a single observation pytree or a
         batch of them (leading axis); the response's leading axis
-        matches the request's."""
+        matches the request's.
+
+        ``deadline_s`` is the caller's patience: past it the request is
+        worthless, so it is rejected up front when provably infeasible
+        at the measured service rate, and purged (future failed, never
+        dispatched) if it expires while queued. Admission failures
+        raise :class:`~torch_actor_critic_tpu.serve.admission.ShedError`
+        with a machine-readable reason."""
         engine, _, _ = self.registry.acquire(slot)  # validates slot name
+        breaker = self.registry.breaker(slot)
+        if breaker is not None and not breaker.admits():
+            # Fail fast while the slot's engine is tripped open: no
+            # queue slot, no accelerator work, a concrete retry hint.
+            self.metrics.record_shed("breaker_open")
+            raise BreakerOpenError(
+                slot, breaker.retry_after_s(), breaker.state
+            )
         obs, rows, batched = self._ensure_batched(engine, obs)
-        req = _Request(obs, rows, slot, bool(deterministic))
+        req = _Request(obs, rows, slot, bool(deterministic), deadline_s)
         outer: Future = Future()
 
         def _copy(f: Future):
@@ -130,7 +185,38 @@ class MicroBatcher:
             # Checked under the lock: a request enqueued after close()
             # flipped the flag would never be drained.
             if not self._running:
-                raise RuntimeError("MicroBatcher is closed")
+                raise ShedError(
+                    "draining",
+                    "MicroBatcher is closed (draining); not accepting "
+                    "new requests",
+                )
+            if len(self._queue) >= self.capacity:
+                self.metrics.record_shed("queue_full")
+                raise ShedError(
+                    "queue_full",
+                    f"admission queue is at capacity "
+                    f"({self.capacity} requests); retry with backoff",
+                    retry_after_s=self._est_backlog_wait_locked() or 1.0,
+                    detail={
+                        "queue_depth": len(self._queue),
+                        "capacity": self.capacity,
+                    },
+                )
+            if deadline_s is not None and self._ema_samples >= 3:
+                est_wait = (
+                    sum(r.rows for r in self._queue) + rows
+                ) * self._ema_row_s
+                if est_wait > deadline_s:
+                    self.metrics.record_shed("deadline_infeasible")
+                    raise ShedError(
+                        "deadline_infeasible",
+                        f"deadline of {deadline_s:.3f}s cannot be met: "
+                        f"estimated completion {est_wait:.3f}s at the "
+                        "current service rate; shedding instead of "
+                        "serving a dead request",
+                        retry_after_s=est_wait,
+                        detail={"estimated_wait_s": round(est_wait, 4)},
+                    )
             self._queue.append(req)
             self.metrics.record_enqueue(len(self._queue))
             self._nonempty.notify()
@@ -143,8 +229,20 @@ class MicroBatcher:
         slot: str = "default",
         timeout: float | None = 30.0,
     ) -> ActResult:
-        """Blocking :meth:`submit`."""
-        return self.submit(obs, deterministic, slot).result(timeout=timeout)
+        """Blocking :meth:`submit`. The timeout doubles as the request
+        deadline: a caller that stops waiting leaves no orphan behind —
+        its queued request is purged at group-collection time instead
+        of burning a forward on an answer nobody reads."""
+        return self.submit(
+            obs, deterministic, slot, deadline_s=timeout
+        ).result(timeout=timeout)
+
+    def _est_backlog_wait_locked(self) -> float | None:
+        """Estimated seconds to drain the current queue (None until the
+        service-rate EMA has warmed up). Callers hold ``self._lock``."""
+        if self._ema_row_s is None:
+            return None
+        return sum(r.rows for r in self._queue) * self._ema_row_s
 
     def _ensure_batched(self, engine, obs):
         """(batched_obs, n_rows, was_batched) — unbatched observations
@@ -178,15 +276,51 @@ class MicroBatcher:
             group = self._collect_group()
             if group is None:
                 return
-            self._run_group(group)
+            if group:  # may be empty when every queued request expired
+                self._run_group(group)
+
+    def _purge_expired_locked(self) -> None:
+        """Fail and drop every queued request whose deadline has
+        passed — the satellite fix for the timed-out-client leak: an
+        abandoned ``act()`` used to stay queued and still burn a TPU
+        forward on an answer nobody reads. Purged requests never reach
+        the engine; counted as ``shed_expired_total``. Callers hold
+        ``self._lock``."""
+        if not any(r.deadline is not None for r in self._queue):
+            return
+        now = time.perf_counter()
+        expired = [
+            r for r in self._queue
+            if r.deadline is not None and now >= r.deadline
+        ]
+        if not expired:
+            return
+        live = [r for r in self._queue if r not in expired]
+        self._queue.clear()
+        self._queue.extend(live)
+        self.metrics.record_expired(len(expired))
+        for r in expired:
+            if not r.future.done():
+                r.future.set_exception(ShedError(
+                    "expired",
+                    f"request deadline passed after "
+                    f"{now - r.t_enq:.3f}s in queue; purged before "
+                    "dispatch",
+                ))
 
     def _collect_group(self) -> t.List[_Request] | None:
         """Block for the next same-``(slot, deterministic)`` run of
         queued requests: up to ``max_batch`` rows, or whatever is
-        queued when the oldest request's deadline expires. ``None``
-        means shutdown with an empty queue."""
+        queued when the oldest request's deadline expires. Expired
+        requests are purged here — group-collection time — so the
+        engine only ever runs live work. ``None`` means shutdown with
+        an empty queue; an empty list means everything queued had
+        expired."""
         with self._nonempty:
-            while not self._queue:
+            while True:
+                self._purge_expired_locked()
+                if self._queue:
+                    break
                 if not self._running:
                     return None
                 self._nonempty.wait(timeout=0.05)
@@ -211,6 +345,12 @@ class MicroBatcher:
                 if remaining <= 0:
                     break
                 self._nonempty.wait(timeout=remaining)
+            # Final purge before dispatch: whatever expired during the
+            # coalescing wait is failed now, never forwarded.
+            self._purge_expired_locked()
+            if not self._queue:
+                return []
+            head = self._queue[0]  # the purge may have changed the head
             group: t.List[_Request] = []
             rows = 0
             while self._queue:
@@ -231,8 +371,21 @@ class MicroBatcher:
         return sub
 
     def _run_group(self, group: t.List[_Request]):
+        slot_name = group[0].slot
+        breaker = self.registry.breaker(slot_name)
+        if breaker is not None and not breaker.allow():
+            # Tripped (or half-open past its probe quota): queued
+            # requests for the slot fail fast — no engine work at all.
+            err = BreakerOpenError(
+                slot_name, breaker.retry_after_s(), breaker.state
+            )
+            for r in group:
+                if not r.future.done():
+                    r.future.set_exception(err)
+                self.metrics.record_shed("breaker_open")
+            return
         try:
-            engine, params, generation = self.registry.acquire(group[0].slot)
+            engine, params, generation = self.registry.acquire(slot_name)
             det = group[0].deterministic
             obs = group[0].obs
             if len(group) > 1:
@@ -249,6 +402,7 @@ class MicroBatcher:
             # larger than its top bucket would make bucket_for raise.
             chunk_rows = min(self.max_batch, engine.max_batch)
             outs = []
+            t_fwd = time.perf_counter()
             for lo in range(0, total, chunk_rows):
                 chunk = jax.tree_util.tree_map(
                     lambda x, lo=lo: x[lo:lo + chunk_rows], obs
@@ -262,6 +416,9 @@ class MicroBatcher:
                 self.metrics.record_batch(
                     rows=n, bucket=engine.bucket_for(n)
                 )
+            self._note_service_rate(
+                time.perf_counter() - t_fwd, total
+            )
             action = outs[0] if len(outs) == 1 else np.concatenate(outs, 0)
             done_t = time.perf_counter()
             lo = 0
@@ -271,12 +428,34 @@ class MicroBatcher:
                 )
                 self.metrics.record_done((done_t - r.t_enq) * 1e3)
                 lo += r.rows
+            if breaker is not None:
+                breaker.record_success()
         except Exception as e:  # noqa: BLE001 — the dispatcher must
             # survive a bad request/params; every caller sees the error.
+            if breaker is not None and not isinstance(
+                e, (KeyError, ValueError, TypeError)
+            ):
+                # Engine health, not request shape: forwards that raise
+                # and non-finite action outputs count toward the trip
+                # threshold; malformed requests / unknown slots do not.
+                breaker.record_failure(e)
             for r in group:
                 if not r.future.done():
                     r.future.set_exception(e)
                 self.metrics.record_error()
+
+    def _note_service_rate(self, dt_s: float, rows: int):
+        """Fold one group's measured seconds-per-row into the EMA the
+        submit-time deadline-feasibility check reads."""
+        if rows <= 0 or dt_s <= 0:
+            return
+        per_row = dt_s / rows
+        with self._lock:
+            self._ema_row_s = (
+                per_row if self._ema_row_s is None
+                else 0.8 * self._ema_row_s + 0.2 * per_row
+            )
+            self._ema_samples += 1
 
     # -------------------------------------------------------------- admin
 

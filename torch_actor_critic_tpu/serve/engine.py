@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torch_actor_critic_tpu.diagnostics.watchdog import get_watchdog
+from torch_actor_critic_tpu.serve.admission import NonFiniteActionError
 
 logger = logging.getLogger(__name__)
 
@@ -103,17 +104,24 @@ class PolicyEngine:
         # key a caller holds across calls.
         donate = jax.default_backend() not in ("cpu",)
 
+        # Each forward also returns an all-finite flag over the action
+        # output — the PR 2 sentinel predicate fused INTO the serving
+        # graph (one reduction per batch, no extra host<->device sync:
+        # the flag rides the same transfer as the actions it guards).
+        # A NaN action must never reach a client, and host-side
+        # np.isfinite over the full output would re-read every row the
+        # accelerator just produced.
         def fwd_sampled(params, obs, key):
             action, _ = self.actor_def.apply(
                 params, obs, key, deterministic=False, with_logprob=False
             )
-            return action
+            return action, jnp.all(jnp.isfinite(action))
 
         def fwd_deterministic(params, obs):
             action, _ = self.actor_def.apply(
                 params, obs, None, deterministic=True, with_logprob=False
             )
-            return action
+            return action, jnp.all(jnp.isfinite(action))
 
         self._fwd = {
             True: jax.jit(
@@ -208,11 +216,11 @@ class PolicyEngine:
         with self._watchdog.source(self._trace_names[bucket]), \
                 jax.profiler.TraceAnnotation(self._trace_names[bucket]):
             if deterministic:
-                out = self._fwd[True](params, padded)
+                out, finite = self._fwd[True](params, padded)
             else:
                 if key is None:
                     raise ValueError("sampled serving needs a PRNG key")
-                out = self._fwd[False](params, padded, key)
+                out, finite = self._fwd[False](params, padded, key)
         with self._lock:
             key_ = (bucket, bool(deterministic))
             if key_ not in self._compiled:
@@ -229,6 +237,8 @@ class PolicyEngine:
                         "bucket ladder (docs/OBSERVABILITY.md)",
                         bucket, deterministic,
                     )
+        if not bool(finite):
+            raise NonFiniteActionError(bucket, bool(deterministic))
         return np.asarray(out)[:n]
 
     # ------------------------------------------------------------ warmup

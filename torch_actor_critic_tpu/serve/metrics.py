@@ -35,6 +35,13 @@ class ServeMetrics:
         self.rows_total = 0
         self.padded_rows_total = 0  # sum of bucket sizes dispatched
         self.queue_depth = 0
+        # Admission-control accounting (docs/SERVING.md "Overload &
+        # degradation"): submit-time rejections by reason, plus
+        # accepted-then-purged requests whose deadline expired in the
+        # queue (the TPU never ran them).
+        self.sheds_total = 0
+        self.shed_by_reason: t.Dict[str, int] = {}
+        self.shed_expired_total = 0
         self._responses_at_snapshot = 0
         self._snapshots_taken = 0
         self._latency = FixedBucketHistogram()
@@ -61,6 +68,25 @@ class ServeMetrics:
         with self._lock:
             self.errors_total += 1
 
+    def record_shed(self, reason: str):
+        """One request rejected by admission control (submit time) or
+        failed fast by the circuit breaker (dispatch time)."""
+        with self._lock:
+            self.sheds_total += 1
+            self.shed_by_reason[reason] = (
+                self.shed_by_reason.get(reason, 0) + 1
+            )
+
+    def record_expired(self, n: int = 1):
+        """Accepted requests purged at group-collection time because
+        their deadline passed while queued — never dispatched."""
+        with self._lock:
+            self.shed_expired_total += n
+            self.sheds_total += n
+            self.shed_by_reason["expired"] = (
+                self.shed_by_reason.get("expired", 0) + n
+            )
+
     # ------------------------------------------------------------ snapshot
 
     def snapshot(self) -> t.Dict[str, t.Any]:
@@ -81,6 +107,9 @@ class ServeMetrics:
                 "errors_total": self.errors_total,
                 "batches_total": self.batches_total,
                 "queue_depth": self.queue_depth,
+                "sheds_total": self.sheds_total,
+                "shed_by_reason": dict(self.shed_by_reason),
+                "shed_expired_total": self.shed_expired_total,
                 "uptime_s": round(lifetime_s, 3),
                 # Occupancy: real rows per dispatched row slot — 1.0
                 # means every forward ran a full bucket, low values mean

@@ -1,4 +1,4 @@
-"""Multi-slot model registry with Orbax checkpoint hot-reload.
+"""Multi-slot model registry with validated Orbax checkpoint hot-reload.
 
 A serving process holds one or more named **slots** (e.g. ``default``,
 ``canary``), each an immutable-at-a-glance triple
@@ -15,15 +15,38 @@ layout): :meth:`reload` checks ``latest_step`` against the slot's
 loaded epoch and swaps when the trainer has written a newer one —
 called manually (the HTTP ``/reload`` endpoint) or by the background
 poller (:meth:`start_polling`).
+
+Every swap is **sentinel-validated** (docs/RESILIENCE.md): the PR 2
+all-finite reduction
+(:func:`~torch_actor_critic_tpu.resilience.sentinel.tree_all_finite`)
+runs over restored params *before* the atomic swap. A NaN-corrupted
+checkpoint — the exact fault the training-side sentinel rolls back
+from — is ``rejected`` and the slot keeps serving its **last-good
+generation**; reload reports the rejection instead of poisoning every
+subsequent response. Reload IO additionally gets the
+:mod:`~torch_actor_critic_tpu.resilience.retry` transient-fault policy
+(bounded retry with backoff), and each slot reloads independently: one
+slot's failure never aborts the others
+(per-slot ``{ok|noop|rejected|error}`` statuses).
+
+Each slot also owns a :class:`~torch_actor_critic_tpu.serve.breaker.
+CircuitBreaker` the micro-batcher consults per group; breaker
+transitions land in a bounded event log (:meth:`breaker_events`) and
+per-slot state/trips/probes export via :meth:`breaker_stats` onto
+``/metrics``.
 """
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
 import typing as t
 
+from torch_actor_critic_tpu.resilience.retry import call_with_retries
+from torch_actor_critic_tpu.resilience.sentinel import tree_all_finite
+from torch_actor_critic_tpu.serve.breaker import CircuitBreaker
 from torch_actor_critic_tpu.serve.engine import PolicyEngine
 
 logger = logging.getLogger(__name__)
@@ -32,23 +55,47 @@ __all__ = ["ModelRegistry"]
 
 
 class _Slot:
-    __slots__ = ("engine", "state", "checkpointer", "lock")
+    __slots__ = (
+        "engine", "state", "checkpointer", "lock", "breaker",
+        "reload_rejected_total",
+    )
 
-    def __init__(self, engine, params, epoch, checkpointer):
+    def __init__(self, engine, params, epoch, checkpointer, breaker):
         self.engine = engine
         # (params, generation, epoch): swapped as ONE tuple so readers
         # can never observe a params/generation mismatch.
         self.state = (params, 0, epoch)
         self.checkpointer = checkpointer
+        self.breaker = breaker
+        self.reload_rejected_total = 0
         self.lock = threading.Lock()
 
 
 class ModelRegistry:
-    def __init__(self):
+    def __init__(
+        self,
+        reload_retries: int = 1,
+        reload_retry_backoff_s: float = 0.5,
+        sleep: t.Callable[[float], None] = time.sleep,
+    ):
         self._slots: t.Dict[str, _Slot] = {}
         self._lock = threading.Lock()
         self._poller: threading.Thread | None = None
         self._poll_stop = threading.Event()
+        # Transient-IO policy for hot-reload (resilience/retry.py):
+        # each slot's probe+restore gets `reload_retries` extra
+        # attempts with exponential backoff before the error lands in
+        # its status. `sleep` is injectable so tests drive the ladder
+        # without real waiting.
+        self._reload_retries = int(reload_retries)
+        self._reload_retry_backoff_s = float(reload_retry_backoff_s)
+        self._sleep = sleep
+        # Bounded breaker-transition log: the telemetry-events view of
+        # every slot breaker (each entry is a JSONL-ready dict), capped
+        # so a flapping breaker cannot grow host memory.
+        self._breaker_events: collections.deque = collections.deque(
+            maxlen=256
+        )
 
     # ------------------------------------------------------- registration
 
@@ -63,12 +110,15 @@ class ModelRegistry:
         buckets: t.Sequence[int] | None = None,
         warmup: bool = True,
         replace: bool = False,
+        breaker: CircuitBreaker | None = None,
     ) -> dict:
         """Create a slot. ``params`` seeds it directly (tests/bench);
         ``ckpt_dir`` loads the latest epoch from an Orbax dir and arms
         hot-reload for it. Exactly one of the two is required.
         ``warmup`` compiles every bucket before the slot goes live, so
-        the first live request never pays a compile.
+        the first live request never pays a compile. ``breaker``
+        overrides the slot's default circuit breaker (tests inject one
+        with a fake clock).
 
         Registering a name that already exists raises unless
         ``replace=True`` — a silent overwrite would discard the old
@@ -97,9 +147,32 @@ class ModelRegistry:
             checkpointer = Checkpointer(ckpt_dir, save_buffer=False)
             params, meta = checkpointer.restore_actor_params()
             epoch = meta["epoch"]
+        # A slot must never go live on poisoned weights: the same
+        # sentinel that validates every hot-reload validates the
+        # initial load (a NaN checkpoint fails registration loudly
+        # instead of serving NaN actions until someone notices).
+        if not tree_all_finite(params):
+            if checkpointer is not None:
+                checkpointer.close()
+            raise ValueError(
+                f"refusing to register slot {name!r}: params contain "
+                "non-finite values (divergence sentinel, "
+                "docs/RESILIENCE.md)"
+            )
+        if breaker is None:
+            breaker = CircuitBreaker(name=name)
+        breaker.name = name
+        user_hook = breaker.on_event
+
+        def _hook(event, _user=user_hook, _slot=name):
+            self._note_breaker_event(dict(event, slot=_slot))
+            if _user is not None:
+                _user(event)
+
+        breaker.on_event = _hook
         if warmup:
             engine.warmup(params)
-        slot = _Slot(engine, params, epoch, checkpointer)
+        slot = _Slot(engine, params, epoch, checkpointer, breaker)
         with self._lock:
             displaced = self._slots.get(name)
             self._slots[name] = slot
@@ -135,6 +208,12 @@ class ModelRegistry:
             params, generation, _ = slot.state
         return slot.engine, params, generation
 
+    def breaker(self, name: str = "default") -> CircuitBreaker | None:
+        """The slot's circuit breaker (None only for foreign slots —
+        every registered slot has one)."""
+        slot = self._slots.get(name)
+        return slot.breaker if slot is not None else None
+
     def slots(self) -> t.Dict[str, dict]:
         """Health/introspection view of every slot."""
         out = {}
@@ -151,6 +230,8 @@ class ModelRegistry:
                 "compiled": sorted(
                     [list(k) for k in slot.engine.compiled_buckets()]
                 ),
+                "breaker": slot.breaker.state,
+                "reload_rejected_total": slot.reload_rejected_total,
             }
         return out
 
@@ -169,11 +250,56 @@ class ModelRegistry:
             "slots": slots,
         }
 
+    # ----------------------------------------------------- circuit breaker
+
+    def _note_breaker_event(self, event: dict):
+        event = dict(event, ts=time.time())
+        self._breaker_events.append(event)
+        logger.warning("breaker event: %s", event)
+
+    def breaker_events(self) -> t.List[dict]:
+        """The most recent breaker transitions (bounded), each a
+        JSONL-ready telemetry event dict."""
+        return list(self._breaker_events)
+
+    def breaker_stats(self) -> dict:
+        """Per-slot breaker state for ``/metrics``: state machine
+        position, trip/probe totals, thresholds."""
+        with self._lock:
+            items = list(self._slots.items())
+        slots = {name: slot.breaker.snapshot() for name, slot in items}
+        return {
+            "trips_total": sum(s["trips_total"] for s in slots.values()),
+            "open_slots": sorted(
+                name for name, s in slots.items() if s["state"] != "closed"
+            ),
+            "events_total": len(self._breaker_events),
+            "slots": slots,
+        }
+
     # --------------------------------------------------------- hot reload
 
-    def swap(self, name: str, params, epoch: int | None = None) -> int:
-        """Atomically install new params; returns the new generation."""
+    def swap(
+        self,
+        name: str,
+        params,
+        epoch: int | None = None,
+        validate: bool = True,
+    ) -> int:
+        """Atomically install new params; returns the new generation.
+
+        ``validate`` runs the all-finite sentinel first and raises
+        ``ValueError`` (no swap, last-good params keep serving) on
+        non-finite params. Only the fault-injection harness passes
+        ``validate=False`` — to plant the poisoned weights the breaker
+        and reload tests need."""
         slot = self._slot(name)
+        if validate and not tree_all_finite(params):
+            raise ValueError(
+                f"refusing to swap slot {name!r}: params contain "
+                "non-finite values; the current generation keeps "
+                "serving (divergence sentinel, docs/RESILIENCE.md)"
+            )
         with slot.lock:
             _, generation, old_epoch = slot.state
             slot.state = (
@@ -182,62 +308,120 @@ class ModelRegistry:
             )
             return generation + 1
 
+    def _reload_slot(self, name: str, slot: _Slot) -> dict:
+        """One slot's reload attempt -> its status dict. Never raises:
+        ``{ok|noop|rejected|error}`` so multi-slot reloads always
+        complete for every slot."""
+        if slot.checkpointer is None:
+            return {
+                "status": "noop", "reloaded": False,
+                "reason": "no checkpoint dir",
+            }
+        with slot.lock:
+            _, generation, loaded_epoch = slot.state
+
+        def probe_and_restore():
+            # The Orbax manager caches its step list; refresh to see
+            # epochs the TRAINER process wrote since our last look.
+            slot.checkpointer.refresh()
+            latest = slot.checkpointer.latest_epoch()
+            if latest is None or (
+                loaded_epoch is not None and latest <= loaded_epoch
+            ):
+                return None
+            # Restore OUTSIDE the slot lock: a multi-second Orbax
+            # read must not stall acquire() (live traffic keeps
+            # flowing on the old params until the swap below).
+            return latest, slot.checkpointer.restore_actor_params(latest)
+
+        try:
+            out = call_with_retries(
+                probe_and_restore,
+                attempts=self._reload_retries + 1,
+                base_delay_s=self._reload_retry_backoff_s,
+                sleep=self._sleep,
+                what=f"slot {name!r} hot-reload",
+            )
+            if out is None:
+                return {
+                    "status": "noop", "reloaded": False,
+                    "epoch": loaded_epoch, "generation": generation,
+                }
+            latest, (params, meta) = out
+            # Sentinel gate BEFORE the swap (deterministic — never
+            # retried): a NaN-corrupted checkpoint keeps the previous
+            # generation serving and the rejection is reported, not
+            # raised mid-serve.
+            if not tree_all_finite(params):
+                slot.reload_rejected_total += 1
+                logger.warning(
+                    "slot %r reload REJECTED: epoch %s params are "
+                    "non-finite; generation %s (last good) keeps "
+                    "serving",
+                    name, latest, generation,
+                )
+                return {
+                    "status": "rejected", "reloaded": False,
+                    "epoch": latest, "generation": generation,
+                    "reason": "non-finite parameters (all-finite "
+                              "sentinel); last-good generation kept",
+                }
+            generation = self.swap(name, params, epoch=latest, validate=False)
+            logger.info(
+                "slot %r hot-reloaded epoch %s (generation %s)",
+                name, latest, generation,
+            )
+            return {
+                "status": "ok", "reloaded": True,
+                "epoch": latest, "generation": generation,
+            }
+        except Exception as e:  # noqa: BLE001 — a half-written or
+            # corrupt checkpoint must not take serving down; the
+            # slot keeps its current params and reports the error.
+            logger.warning("slot %r reload failed: %r", name, e)
+            return {
+                "status": "error", "reloaded": False,
+                "error": repr(e)[:200],
+            }
+
     def reload(self, name: str | None = None) -> t.Dict[str, dict]:
         """Check checkpoint-backed slots for a newer epoch; swap those
-        that have one. Returns per-slot status."""
+        that have one (sentinel-validated). Returns per-slot
+        ``{ok|noop|rejected|error}`` statuses — one slot's failure
+        never aborts reloading the remaining slots."""
         with self._lock:
             names = [name] if name is not None else list(self._slots)
         out = {}
         for n in names:
-            slot = self._slot(n)
-            if slot.checkpointer is None:
-                out[n] = {"reloaded": False, "reason": "no checkpoint dir"}
-                continue
-            with slot.lock:
-                _, generation, loaded_epoch = slot.state
             try:
-                # The Orbax manager caches its step list; refresh to see
-                # epochs the TRAINER process wrote since our last look.
-                slot.checkpointer.refresh()
-                latest = slot.checkpointer.latest_epoch()
-                if latest is None or (
-                    loaded_epoch is not None and latest <= loaded_epoch
-                ):
-                    out[n] = {
-                        "reloaded": False, "epoch": loaded_epoch,
-                        "generation": generation,
-                    }
-                    continue
-                # Restore OUTSIDE the slot lock: a multi-second Orbax
-                # read must not stall acquire() (live traffic keeps
-                # flowing on the old params until the swap below).
-                params, meta = slot.checkpointer.restore_actor_params(latest)
-                generation = self.swap(n, params, epoch=latest)
+                out[n] = self._reload_slot(n, self._slot(n))
+            except Exception as e:  # noqa: BLE001 — isolation: even a
+                # failure OUTSIDE the per-slot path (unknown name,
+                # a concurrently-removed slot) costs one status entry
                 out[n] = {
-                    "reloaded": True, "epoch": latest,
-                    "generation": generation,
+                    "status": "error", "reloaded": False,
+                    "error": repr(e)[:200],
                 }
-                logger.info(
-                    "slot %r hot-reloaded epoch %s (generation %s)",
-                    n, latest, generation,
-                )
-            except Exception as e:  # noqa: BLE001 — a half-written or
-                # corrupt checkpoint must not take serving down; the
-                # slot keeps its current params and reports the error.
-                logger.warning("slot %r reload failed: %r", n, e)
-                out[n] = {"reloaded": False, "error": repr(e)[:200]}
         return out
 
     def start_polling(self, interval_s: float = 5.0):
         """Background hot-reload: poll checkpoint dirs every
-        ``interval_s`` seconds."""
+        ``interval_s`` seconds. The watcher never dies to one bad
+        poll — reload already isolates per-slot failures, and any
+        error that still escapes is logged and the next tick polls
+        again."""
         if self._poller is not None:
             raise RuntimeError("poller already running")
         self._poll_stop.clear()
 
         def loop():
             while not self._poll_stop.wait(timeout=interval_s):
-                self.reload()
+                try:
+                    self.reload()
+                except Exception:  # noqa: BLE001 — pragma: no cover —
+                    # reload() isolates per-slot errors; this is the
+                    # watcher's own last line of defense
+                    logger.exception("hot-reload poll failed; will retry")
 
         self._poller = threading.Thread(
             target=loop, name="ckpt-poller", daemon=True

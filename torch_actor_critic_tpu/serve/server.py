@@ -14,15 +14,28 @@ work:
 - ``POST /act``     ``{"obs": [...] | {"features": [...], "frame": [...]},
   "deterministic": bool, "model": "default"}`` ->
   ``{"action": [...], "generation": N, "model": "..."}``
-- ``GET /healthz``  liveness + per-slot generation/epoch
+- ``GET /healthz``  liveness + per-slot generation/epoch (``draining``
+  with HTTP 503 once a drain has started, so load balancers eject the
+  replica while in-flight work finishes)
 - ``GET /metrics``  :meth:`~torch_actor_critic_tpu.serve.metrics.ServeMetrics.snapshot`
 - ``POST /reload``  force a checkpoint poll now (hot-reload check)
+
+Overload contract (docs/SERVING.md "Overload & degradation"): a
+request the admission layer rejects at submit time — queue full or
+deadline infeasible — answers **429** + ``Retry-After`` (the service
+is healthy, the rate is not); a request the service cannot currently
+serve — breaker open, draining, expired in queue, backend timeout —
+answers **503** + ``Retry-After``. Every rejection carries the
+structured :class:`~torch_actor_critic_tpu.serve.admission.ShedError`
+payload (``reason``, ``retry_after_s``).
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import math
+import signal
 import threading
 import typing as t
 from concurrent.futures import TimeoutError as FutureTimeoutError
@@ -33,13 +46,17 @@ import numpy as np
 
 from torch_actor_critic_tpu.core.types import MultiObservation
 from torch_actor_critic_tpu.diagnostics.watchdog import get_watchdog as _watchdog
+from torch_actor_critic_tpu.serve.admission import (
+    SUBMIT_SHED_REASONS,
+    ShedError,
+)
 from torch_actor_critic_tpu.serve.batcher import ActResult, MicroBatcher
 from torch_actor_critic_tpu.serve.metrics import ServeMetrics
 from torch_actor_critic_tpu.serve.registry import ModelRegistry
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["PolicyClient", "PolicyServer"]
+__all__ = ["PolicyClient", "PolicyServer", "install_drain_handler"]
 
 
 class PolicyClient:
@@ -105,6 +122,7 @@ class PolicyServer:
         request_timeout_s: float = 30.0,
         act_timeout_s: float = 30.0,
         extra_snapshot: t.Callable[[], dict] | None = None,
+        capacity: int = 1024,
     ):
         self.registry = registry
         # Co-located processes (a trainer serving its own policy, a
@@ -123,9 +141,17 @@ class PolicyServer:
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.batcher = MicroBatcher(
             registry, max_batch=max_batch, max_wait_ms=max_wait_ms,
-            metrics=self.metrics, seed=seed,
+            metrics=self.metrics, seed=seed, capacity=capacity,
         )
         self.client = PolicyClient(registry, self.batcher)
+        # Graceful-drain state (docs/SERVING.md "Overload &
+        # degradation"): once draining, /healthz answers 503 so load
+        # balancers stop routing here, new /act requests are shed with
+        # 503 + Retry-After, and the queue flushes through the engine
+        # before the process exits — rolling restarts drop zero
+        # accepted requests.
+        self._draining = False
+        self._drain_lock = threading.Lock()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -158,11 +184,16 @@ class PolicyServer:
 
             def do_GET(self):  # noqa: N802 — stdlib API
                 if self.path == "/healthz":
-                    self._send(200, {
-                        "status": "ok",
-                        "queue_depth": server.batcher.queue_depth(),
-                        "slots": server.registry.slots(),
-                    })
+                    draining = server._draining
+                    self._send(
+                        503 if draining else 200,
+                        {
+                            "status": "draining" if draining else "ok",
+                            "queue_depth": server.batcher.queue_depth(),
+                            "slots": server.registry.slots(),
+                        },
+                        headers={"Retry-After": "1"} if draining else None,
+                    )
                 elif self.path == "/metrics":
                     snap = server.metrics.snapshot()
                     # Compile accounting + the process-wide watchdog
@@ -175,6 +206,11 @@ class PolicyServer:
                     snap["live_compiles"] = comp["live_compiles"]
                     snap["compiles"] = comp["slots"]
                     snap["xla"] = _watchdog().snapshot()
+                    # Overload containment state: admission bound and
+                    # per-slot breaker trips/probes/state.
+                    snap["queue_capacity"] = server.batcher.capacity
+                    snap["draining"] = server._draining
+                    snap["breakers"] = server.registry.breaker_stats()
                     if server.extra_snapshot is not None:
                         try:
                             snap.update(server.extra_snapshot())
@@ -203,6 +239,17 @@ class PolicyServer:
                     self._send(404, {"error": f"no route {self.path}"})
 
             def _act(self, body: dict):
+                if server._draining:
+                    self._send(
+                        503,
+                        {
+                            "error": "server is draining; not accepting "
+                                     "new requests",
+                            "reason": "draining",
+                        },
+                        headers={"Retry-After": "1"},
+                    )
+                    return
                 slot = body.get("model", "default")
                 try:
                     engine, _, _ = server.registry.acquire(slot)
@@ -220,6 +267,21 @@ class PolicyServer:
                         slot=slot,
                         timeout=server.act_timeout_s,
                     )
+                except ShedError as e:
+                    # Admission control / breaker / drain: submit-time
+                    # rejections (queue_full, deadline_infeasible) are
+                    # 429 — the service is healthy, the RATE is not;
+                    # everything else (breaker_open, draining, expired
+                    # in queue) is 503 — back off and let the load
+                    # balancer try another replica. Both carry
+                    # Retry-After from the shed's own estimate.
+                    code = 429 if e.reason in SUBMIT_SHED_REASONS else 503
+                    retry_after = max(1, math.ceil(e.retry_after_s))
+                    self._send(
+                        code, e.to_payload(),
+                        headers={"Retry-After": str(retry_after)},
+                    )
+                    return
                 except FutureTimeoutError:
                     # Batcher overload/stall is transient, not a server
                     # bug: 503 + Retry-After tells well-behaved clients
@@ -282,18 +344,118 @@ class PolicyServer:
         finally:
             self.close()
 
-    def close(self):
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, flush_timeout_s: float = 30.0) -> dict:
+        """Graceful drain: stop admitting, flush, report.
+
+        From the first call, ``/healthz`` answers 503 ``draining`` (the
+        load balancer ejects this replica) and new ``/act`` requests
+        are shed with 503 + ``Retry-After`` — then every request
+        already accepted flushes through the engine (the batcher close
+        path answers its whole queue before joining), so in-flight HTTP
+        handlers parked on Futures all complete normally. Idempotent;
+        returns what happened so the caller (SIGTERM handler, tests)
+        can assert zero accepted requests were dropped."""
+        with self._drain_lock:
+            first = not self._draining
+            self._draining = True
+        if first:
+            logger.info(
+                "draining: admissions stopped, flushing %d queued "
+                "requests", self.batcher.queue_depth(),
+            )
+        self.batcher.close(timeout=flush_timeout_s)
+        remaining = self.batcher.queue_depth()
+        if remaining:  # pragma: no cover — only a wedged engine
+            logger.warning(
+                "drain flush left %d requests unanswered after %.1fs",
+                remaining, flush_timeout_s,
+            )
+        snap = self.metrics.snapshot()
+        return {
+            "drained": remaining == 0,
+            "queued_at_exit": remaining,
+            "responses_total": snap["responses_total"],
+            "sheds_total": snap["sheds_total"],
+        }
+
+    def close(self, thread_join_timeout_s: float = 10.0) -> dict:
+        """Stop everything; returns a structured result. A server
+        thread that survives its join (a handler wedged past every
+        timeout) is LOGGED and surfaced in the result instead of
+        silently leaking — the caller deciding to exit anyway should
+        know a non-daemon-joinable thread is still out there."""
+        result = {"server_thread_stopped": True}
         _watchdog().clear_steady("serve/")
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
-            self._thread.join(timeout=10.0)
+            self._thread.join(timeout=thread_join_timeout_s)
+            if self._thread.is_alive():
+                logger.warning(
+                    "server thread %r still alive after %.1fs join "
+                    "(daemon=%s) — leaking it; a handler is wedged "
+                    "past its timeouts",
+                    self._thread.name, thread_join_timeout_s,
+                    self._thread.daemon,
+                )
+                result["server_thread_stopped"] = False
+                result["server_thread"] = {
+                    "name": self._thread.name,
+                    "daemon": self._thread.daemon,
+                }
             self._thread = None
         self.batcher.close()
         self.registry.close()
+        return result
 
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
         self.close()
+
+
+def install_drain_handler(
+    server: PolicyServer,
+    signals: t.Sequence[int] = (signal.SIGTERM,),
+    flush_timeout_s: float = 30.0,
+) -> t.Callable[[], None]:
+    """SIGTERM → graceful drain → clean exit (the rolling-restart
+    contract): admissions stop (503 + ``Retry-After``; ``/healthz``
+    flips to ``draining``), the queue flushes through the engine, the
+    HTTP loop is released — ``serve_forever`` returns, ``close()`` runs
+    and the process exits 0 having answered every accepted request.
+
+    The drain runs on a helper thread: a Python signal handler executes
+    on the main thread, which for the CLI is the one blocked inside
+    ``serve_forever`` — flushing there would deadlock. Must be called
+    from the main thread (stdlib ``signal`` requirement). Returns the
+    drain trigger so tests can invoke the same path directly."""
+
+    def _drain_and_release():
+        try:
+            info = server.drain(flush_timeout_s=flush_timeout_s)
+            logger.info("drain complete: %s", info)
+        finally:
+            # Releases serve_forever(); its finally-close() handles the
+            # rest. Safe when start() was used instead: shutdown() of a
+            # stopped loop is a no-op.
+            server._httpd.shutdown()
+
+    def _handler(signum, frame):  # pragma: no cover — exercised via
+        # the direct trigger in tests (signal delivery itself is the
+        # stdlib's contract, not ours)
+        logger.info("signal %d: starting graceful drain", signum)
+        threading.Thread(
+            target=_drain_and_release, name="drain", daemon=True
+        ).start()
+
+    for sig in signals:
+        signal.signal(sig, _handler)
+    return lambda: threading.Thread(
+        target=_drain_and_release, name="drain", daemon=True
+    ).start()
