@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke
+.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -59,6 +59,14 @@ trace-smoke:
 # "Learning-health diagnostics").
 diag-smoke:
 	JAX_PLATFORMS=cpu python scripts/diag_smoke.py
+
+# Population-fused smoke: tiny CPU run of the vmapped Anakin loop
+# (--on-device --population 4 --pbt-every 1) through the real CLI;
+# asserts N distinct finite learning curves, at least one PBT exploit
+# event with a schema-valid telemetry record, and a successful resume
+# of the population checkpoint (docs/SCALING.md "population").
+pop-smoke:
+	JAX_PLATFORMS=cpu python scripts/pop_smoke.py
 
 # Fault-injection suite: every recovery path (NaN rollback, SIGTERM
 # save+requeue+bitwise resume, checkpoint retry/fallback, dead env

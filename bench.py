@@ -492,6 +492,83 @@ def bench_population(budget_s=420.0):
     return out
 
 
+def bench_population_fused(budget_s=420.0):
+    """Population-FUSED scaling: the entire Anakin epoch — envs, replay
+    rings, PRNG streams and update bursts — vmapped over N members
+    (sac/ondevice.py PopulationOnDeviceLoop), so acting is included,
+    not just gradient steps. Reports AGGREGATE env-steps/s and
+    grad-steps/s vs N plus an estimated MFU (gradient-burst FLOPs only;
+    the pendulum physics is negligible), the conversion rate of the
+    measured idle MXU into whole learning curves.
+    """
+    import jax
+
+    from torch_actor_critic_tpu.envs.ondevice import PendulumJax
+    from torch_actor_critic_tpu.sac.ondevice import (
+        PopulationOnDeviceLoop,
+        _wrap_and_build,
+    )
+    from torch_actor_critic_tpu.utils.config import SACConfig
+    from torch_actor_critic_tpu.utils.sync import drain
+
+    cfg = SACConfig(batch_size=BATCH, hidden_sizes=HIDDEN)
+    env_cls, sac = _wrap_and_build(PendulumJax, cfg)
+    steps, n_envs = 2 * BURST, 8
+    flops = sac_flops_per_step(
+        batch=BATCH, hidden=HIDDEN, obs=PendulumJax.obs_dim,
+        act=PendulumJax.act_dim,
+    )
+    try:
+        peak = peak_flops_for(jax.devices()[0].device_kind)
+    except Exception:  # noqa: BLE001
+        peak = None
+
+    out = []
+    t_start = time.time()
+    base_sps = None
+    for n_members in (1, 8, 32, 128):
+        if time.time() - t_start > budget_s:
+            break
+        entry = {"members": n_members}
+        try:
+            loop = PopulationOnDeviceLoop(
+                sac, env_cls, n_members=n_members, n_envs=n_envs
+            )
+            ts, buf, es, keys, _ = loop.init(
+                jax.random.key(0), buffer_capacity=20_000
+            )
+            ts, buf, es, keys, _ = loop.epoch(
+                ts, buf, es, keys, steps=BURST, update_every=BURST,
+                warmup=True,
+            )
+            # compile the measured shape, then time a fresh dispatch
+            ts, buf, es, keys, m = loop.epoch(
+                ts, buf, es, keys, steps=steps, update_every=BURST
+            )
+            drain(m["loss_q"])
+            t0 = time.perf_counter()
+            ts, buf, es, keys, m = loop.epoch(
+                ts, buf, es, keys, steps=steps, update_every=BURST
+            )
+            drain(m["loss_q"])
+            dt = time.perf_counter() - t0
+            agg_gs = steps * n_members / dt
+            entry["grad_steps_per_sec_aggregate"] = round(agg_gs, 1)
+            entry["env_steps_per_sec_aggregate"] = round(
+                steps * n_envs * n_members / dt, 1
+            )
+            if peak:
+                entry["est_mfu"] = round(agg_gs * flops / peak, 5)
+            if n_members == 1:
+                base_sps = agg_gs
+            if base_sps is not None:
+                entry["scaling_vs_1"] = round(agg_gs / base_sps, 2)
+        except Exception as e:  # noqa: BLE001 — per-point best effort
+            entry["error"] = repr(e)[:200]
+        out.append(entry)
+    return out
+
+
 def bench_unroll(budget_s=300.0):
     """Burst-scan unroll tuning at the headline config: the per-step
     kernels are launch-bound at batch 64 x [256,256], so unrolling the
@@ -1582,7 +1659,15 @@ _STAGES = {
     "sweep": lambda: {"sweep": bench_sweep()},
     "unroll": lambda: {"burst_unroll": bench_unroll()},
     "td3": lambda: {"td3": bench_td3()},
-    "population": lambda: {"population": bench_population()},
+    # Both population sub-stages share the one subprocess timeout
+    # (720s in main()), so their internal budgets are trimmed to fit
+    # alongside backend init + compiles.
+    "population": lambda: {
+        "population": bench_population(budget_s=300.0),
+        # The fused sub-stage: whole Anakin epochs (acting included)
+        # vmapped over the member axis, not just the update burst.
+        "population_fused": bench_population_fused(budget_s=280.0),
+    },
     "visual": lambda: {"visual": bench_visual()},
     "serving": lambda: {"serving": bench_serving()},
     "overload": lambda: {"overload": bench_overload()},
@@ -1716,7 +1801,7 @@ def main():
             # attention runs two lengths with 180s internal budgets
             # each; its timeout covers both plus init + compiles.
             ("sweep", 900), ("unroll", 420), ("td3", 420),
-            ("population", 600), ("on_device", 540), ("attention", 900),
+            ("population", 720), ("on_device", 540), ("attention", 900),
         ):
             res = run_stage_subprocess(
                 stage, timeout_s, diagnostics, platform=info.get("platform")

@@ -112,8 +112,52 @@ def test_population_rejects_bad_geometry():
         PopulationLearner(sac, 3, make_mesh(dp=2))
     with pytest.raises(ValueError, match="population must be >= 1"):
         SACConfig(population=0)
+    # population x on_device is now the population-fused loop — a
+    # valid combination (sac/ondevice.py PopulationOnDeviceLoop).
+    SACConfig(population=2, on_device=True)
+    # PBT knob validation.
+    with pytest.raises(ValueError, match="population"):
+        SACConfig(pbt_every=2)
     with pytest.raises(ValueError, match="on-device"):
-        SACConfig(population=2, on_device=True)
+        SACConfig(pbt_every=2, population=4)
+    with pytest.raises(ValueError, match="pbt_quantile"):
+        SACConfig(pbt_every=1, population=4, on_device=True,
+                  pbt_quantile=0.75)
+    with pytest.raises(ValueError, match="pbt_perturb"):
+        SACConfig(pbt_every=1, population=4, on_device=True,
+                  pbt_perturb=0.9)
+    with pytest.raises(ValueError, match="pbt_ema"):
+        SACConfig(pbt_every=1, population=4, on_device=True, pbt_ema=0.0)
+
+
+def test_population_burst_cache_keyed_by_num_updates():
+    """Alternating burst sizes must each keep their own compiled entry
+    (the single-slot cache re-jitted EVERY call when sizes alternated)
+    and dispatch under the train/population_burst watchdog scope."""
+    from torch_actor_critic_tpu.diagnostics import get_watchdog
+
+    sac = _learner()
+    pop = PopulationLearner(sac, 2)
+    state = pop.init_state(jax.random.key(0), jnp.zeros((OBS,)))
+    buffer = pop.init_buffer(64, jax.ShapeDtypeStruct((OBS,), jnp.float32), ACT)
+    wd = get_watchdog().install()
+
+    def scope_compiles():
+        return wd.snapshot()["by_source"].get("train/population_burst", 0)
+
+    for i, n in enumerate((2, 3)):
+        chunk = _chunk(jax.random.key(10 + i), 2)
+        state, buffer, _ = pop.update_burst(state, buffer, chunk, n)
+    assert sorted(pop._bursts) == [2, 3]
+    assert scope_compiles() > 0  # dispatches attributed to the scope
+    fn2, fn3 = pop._bursts[2], pop._bursts[3]
+    steady = scope_compiles()
+    for i, n in enumerate((2, 3, 2, 3)):
+        chunk = _chunk(jax.random.key(50 + i), 2)
+        state, buffer, _ = pop.update_burst(state, buffer, chunk, n)
+    # cached callables reused, and NOT one recompile per alternation
+    assert (pop._bursts[2], pop._bursts[3]) == (fn2, fn3)
+    assert scope_compiles() == steady, wd.snapshot()["by_source"]
 
 
 @pytest.fixture(scope="module")

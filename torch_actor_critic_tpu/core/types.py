@@ -101,6 +101,16 @@ class TrainState:
     policy) and holds the TD3 extension's target actor; a ``None`` field
     contributes no pytree leaves, so SAC states — and their checkpoints
     — are unchanged by its existence.
+
+    ``hyperparams`` (``None`` by default — again zero extra leaves) is
+    the PBT extension's per-run hyperparameter pytree: a flat dict of
+    scalar arrays (``actor_lr``, ``critic_lr``, ``alpha`` /
+    ``target_entropy``, ``target_noise``) the learner reads at trace
+    time *instead of* the Python scalars baked into its optax
+    transforms, so a vmapped population can carry N different learning
+    rates/temperatures through ONE compiled program and an on-device
+    exploit/explore step can rewrite them without recompiling (see
+    ``SAC.default_hyperparams`` / ``PopulationOnDeviceLoop``).
     """
 
     step: jax.Array  # int32: gradient steps taken
@@ -113,6 +123,7 @@ class TrainState:
     alpha_opt_state: optax.OptState
     rng: jax.Array
     target_actor_params: t.Any = None
+    hyperparams: t.Any = None
 
 
 def tree_stack(trees: t.Sequence[t.Any]) -> t.Any:

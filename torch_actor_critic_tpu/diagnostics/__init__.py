@@ -42,6 +42,7 @@ from torch_actor_critic_tpu.diagnostics.ingraph import (
     reduction_for,
     replica_skew,
     saturation_fraction,
+    split_member_metrics,
 )
 from torch_actor_critic_tpu.diagnostics.monitor import (
     DEFAULT_RULES,
@@ -72,4 +73,5 @@ __all__ = [
     "reduction_for",
     "replica_skew",
     "saturation_fraction",
+    "split_member_metrics",
 ]

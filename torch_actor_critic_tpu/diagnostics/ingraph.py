@@ -67,6 +67,7 @@ __all__ = [
     "reduction_for",
     "replica_skew",
     "saturation_fraction",
+    "split_member_metrics",
 ]
 
 # TD-error magnitude bucket spec: |TD| from 1e-3 to 1e4 at the same
@@ -161,6 +162,45 @@ def replica_skew(
         for k in keys
         if k in metrics
     }
+
+
+def split_member_metrics(metrics: t.Mapping[str, t.Any]) -> dict:
+    """Per-member metric layout for population training (host-side).
+
+    A population epoch reports every metric with a leading member axis
+    — N real learning curves, not one averaged one. This expands each
+    ``(N,)`` value into ``{key}_m{i}`` scalars (the layout the
+    trainer's ``reward_m{i}`` keys established; see
+    docs/OBSERVABILITY.md) AND keeps a population aggregate under the
+    base key, reduced per the suffix convention above over the FINITE
+    members only (a member with no finished episodes reports NaN
+    ``reward``; averaging that away would blank the aggregate curve).
+    Scalars pass through; ``_hist`` keys sum their member axis and keep
+    the bucket axis.
+    """
+    out: dict = {}
+    for k, v in metrics.items():
+        arr = np.asarray(v)
+        if arr.ndim == 0:
+            out[k] = float(arr)
+            continue
+        if k.endswith("_hist"):
+            out[k] = arr.reshape(-1, arr.shape[-1]).sum(axis=0)
+            continue
+        for i, x in enumerate(arr.reshape(arr.shape[0], -1).mean(axis=1)):
+            out[f"{k}_m{i}"] = float(x)
+        finite = arr[np.isfinite(arr)]
+        if finite.size == 0:
+            out[k] = float("nan")
+            continue
+        r = reduction_for(k)
+        out[k] = float(
+            finite.sum() if r == "sum"
+            else finite.max() if r == "max"
+            else finite.min() if r == "min"
+            else finite.mean()
+        )
+    return out
 
 
 def reduce_metric_rows(rows: t.Sequence[t.Mapping[str, t.Any]]) -> dict:

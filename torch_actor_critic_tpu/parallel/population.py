@@ -93,7 +93,11 @@ class PopulationLearner:
                     "members)"
                 )
             self._sharding = NamedSharding(mesh, P("dp"))
-        self._burst = None
+        # Keyed by num_updates: the trainer's steady cadence is one
+        # size, but callers alternating burst sizes (utd sweeps, warmup
+        # tails, tests) must hit a cache per size — a single-slot cache
+        # silently re-jitted EVERY call when two sizes alternate.
+        self._bursts: t.Dict[int, t.Callable] = {}
         self._push = None
         self._select = None
 
@@ -171,22 +175,27 @@ class PopulationLearner:
         """Push each member's chunk into its own ring, then run
         ``num_updates`` gradient steps for every member — one device
         dispatch for the whole population. Metrics keep their leading
-        member axis: N real learning curves, not one averaged one."""
-        if self._burst is None or self._burst[0] != num_updates:
+        member axis: N real learning curves, not one averaged one.
+
+        Dispatches inside a ``train/population_burst`` watchdog scope:
+        once the trainer marks the ``train/`` regime steady, any XLA
+        compile landing here is flagged as a hot-path recompile
+        anomaly (docs/OBSERVABILITY.md)."""
+        fn = self._bursts.get(num_updates)
+        if fn is None:
 
             def one_member(st, buf, ch):
                 return self.learner.update_burst(
                     st, buf, ch, num_updates, axis_name=None
                 )
 
-            self._burst = (
-                num_updates,
-                jax.jit(
-                    jax.vmap(one_member),
-                    donate_argnums=(0, 1),
-                ),
+            fn = self._bursts[num_updates] = jax.jit(
+                jax.vmap(one_member), donate_argnums=(0, 1)
             )
-        return self._burst[1](state, buffer, chunk)
+        from torch_actor_critic_tpu.diagnostics.watchdog import get_watchdog
+
+        with get_watchdog().source("train/population_burst"):
+            return fn(state, buffer, chunk)
 
     def push_chunk(self, buffer: BufferState, chunk: Batch) -> BufferState:
         """Warmup-path store (no gradient steps), vmapped per member."""

@@ -41,6 +41,33 @@ from torch_actor_critic_tpu.utils.config import SACConfig
 Metrics = t.Dict[str, jax.Array]
 
 
+def dynamic_lr_step(
+    core: optax.GradientTransformation,
+    tx: optax.GradientTransformation,
+    grads: t.Any,
+    opt_state: optax.OptState,
+    params: t.Any,
+    lr: jax.Array | None,
+) -> t.Tuple[t.Any, optax.OptState]:
+    """One Adam step with the learning rate as a *traced* value.
+
+    ``optax.adam(lr)`` bakes the rate into the transform as a Python
+    scalar, so N population members would need N compiled programs to
+    train at N different rates. With ``lr`` given, this replays adam's
+    exact op sequence — ``scale_by_adam`` (``core``, sharing the chain's
+    first state slot) then multiply by ``-lr`` — so the update is
+    bitwise-identical to ``tx.update`` when ``lr`` equals the baked-in
+    rate (pinned by tests) and the opt-state pytree structure never
+    changes. ``lr=None`` is the plain path.
+    """
+    if lr is None:
+        return tx.update(grads, opt_state, params)
+    inner, *rest = opt_state
+    updates, inner = core.update(grads, inner, params)
+    updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
+    return updates, (inner, *rest)
+
+
 class SAC:
     """SAC learner over arbitrary (actor_def, critic_def) Flax modules.
 
@@ -65,15 +92,38 @@ class SAC:
         self.critic_def = critic_def
         self.act_dim = act_dim
         # Adam with torch-default eps, like the reference's
-        # optim.Adam(lr=3e-4) (ref main.py:93-95).
+        # optim.Adam(lr=3e-4) (ref main.py:93-95). `_adam_core` is the
+        # lr-free first stage of the same chain, for the dynamic-lr
+        # (per-member hyperparameter) path — see dynamic_lr_step.
         self.pi_tx = optax.adam(config.lr)
         self.q_tx = optax.adam(config.lr)
         self.alpha_tx = optax.adam(config.lr)
+        self._adam_core = optax.scale_by_adam()
         self.target_entropy = (
             config.target_entropy
             if config.target_entropy is not None
             else -float(act_dim)
         )
+
+    def default_hyperparams(self) -> t.Dict[str, jax.Array]:
+        """The PBT-perturbable hyperparameters as scalar arrays, at
+        their configured values. Stored in ``TrainState.hyperparams``
+        they OVERRIDE the baked-in Python scalars at trace time; with
+        ``hyperparams=None`` the update traces the historical program
+        bit-for-bit. SAC exposes the two learning rates plus whichever
+        temperature knob is live: ``alpha`` itself when fixed,
+        ``target_entropy`` when the temperature is learned."""
+        import jax.numpy as jnp
+
+        hp = {
+            "actor_lr": jnp.float32(self.config.lr),
+            "critic_lr": jnp.float32(self.config.lr),
+        }
+        if self.config.learn_alpha:
+            hp["target_entropy"] = jnp.float32(self.target_entropy)
+        else:
+            hp["alpha"] = jnp.float32(self.config.alpha)
+        return hp
 
     # ------------------------------------------------------------------ init
 
@@ -157,11 +207,15 @@ class SAC:
             # reproduce pre-augmentation streams bit-for-bit (resumed
             # checkpoints, recorded evidence runs).
             rng, key_q, key_pi = jax.random.split(state.rng, 3)
-        alpha = (
-            jnp.exp(jax.lax.stop_gradient(state.log_alpha))
-            if cfg.learn_alpha
-            else jnp.float32(cfg.alpha)
-        )
+        # Per-run hyperparameters (PBT): when the state carries a
+        # hyperparams dict its traced values replace the config scalars
+        # — same compiled program for every member of a population.
+        hp = state.hyperparams if state.hyperparams is not None else {}
+        if cfg.learn_alpha:
+            alpha = jnp.exp(jax.lax.stop_gradient(state.log_alpha))
+            target_entropy = hp.get("target_entropy", self.target_entropy)
+        else:
+            alpha = hp.get("alpha", jnp.float32(cfg.alpha))
 
         # --- critic step ---
         (loss_q, q_aux), q_grads = jax.value_and_grad(
@@ -187,8 +241,9 @@ class SAC:
             diag_metrics["diag/grad_norm_q"] = diag.global_norm(q_grads)
         if axis_name is not None:
             q_grads = jax.lax.pmean(q_grads, axis_name)
-        q_updates, q_opt_state = self.q_tx.update(
-            q_grads, state.q_opt_state, state.critic_params
+        q_updates, q_opt_state = dynamic_lr_step(
+            self._adam_core, self.q_tx, q_grads, state.q_opt_state,
+            state.critic_params, hp.get("critic_lr"),
         )
         critic_params = optax.apply_updates(state.critic_params, q_updates)
         if tier != "off":
@@ -216,8 +271,9 @@ class SAC:
             diag_metrics["diag/grad_norm_pi"] = diag.global_norm(pi_grads)
         if axis_name is not None:
             pi_grads = jax.lax.pmean(pi_grads, axis_name)
-        pi_updates, pi_opt_state = self.pi_tx.update(
-            pi_grads, state.pi_opt_state, state.actor_params
+        pi_updates, pi_opt_state = dynamic_lr_step(
+            self._adam_core, self.pi_tx, pi_grads, state.pi_opt_state,
+            state.actor_params, hp.get("actor_lr"),
         )
         actor_params = optax.apply_updates(state.actor_params, pi_updates)
         if tier != "off":
@@ -231,7 +287,7 @@ class SAC:
         if cfg.learn_alpha:
             a_grad = jax.grad(
                 lambda la: losses.alpha_loss(
-                    la, pi_aux["logp_pi"], self.target_entropy
+                    la, pi_aux["logp_pi"], target_entropy
                 )
             )(state.log_alpha)
             if tier != "off":
@@ -262,6 +318,7 @@ class SAC:
             log_alpha=log_alpha,
             alpha_opt_state=alpha_opt_state,
             rng=rng,
+            hyperparams=state.hyperparams,
         )
         metrics = {
             "loss_q": loss_q,

@@ -24,6 +24,7 @@ import typing as t
 import jax
 import jax.numpy as jnp
 
+from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torch_actor_critic_tpu.buffer.replay import init_replay_buffer, push
@@ -333,6 +334,298 @@ class OnDeviceLoop:
         return self._epoch_fns[sig](train_state, buffer, env_states, act_key)
 
 
+@struct.dataclass
+class PBTState:
+    """On-device population-based-training bookkeeping.
+
+    ``return_ema`` is the in-loop per-member episode-return EMA the
+    exploit step ranks on; ``ema_count`` counts epochs that contributed
+    (a member with no finished episodes yet must not be ranked —
+    exploit is gated until every member has a real estimate); ``rng``
+    drives the winner-pick and explore-perturbation draws. All device
+    arrays: the whole exploit/explore decision is in-graph.
+    """
+
+    return_ema: jax.Array  # (n_members,) float32
+    ema_count: jax.Array   # (n_members,) int32
+    rng: jax.Array         # PRNG key
+
+
+class PopulationOnDeviceLoop:
+    """N complete fused training runs advanced by ONE device dispatch.
+
+    The member axis is ``jax.vmap`` over the ENTIRE
+    :class:`OnDeviceLoop` epoch program — vectorized envs, replay
+    rings, PRNG streams and the update bursts all inside the one
+    ``lax.scan`` under one ``jit`` — so each dispatch advances N
+    complete, independent learning curves (acting included, not just
+    gradient steps). This is the Anakin topology (PAPERS.md) stretched
+    over the population axis: the measured idle MXU at the product
+    config (~1-2% MFU while the chip sustains 0.70 — BENCH_r04) is
+    converted into aggregate env-steps/s and grad-steps/s that scale
+    near-linearly in N, because XLA folds the member axis into the
+    matmul tiles.
+
+    Independence contract (pinned by ``tests/test_population_fused.py``):
+    members share NOTHING — separate env batches, replay rings,
+    optimizer states and PRNG streams; member ``i``'s epoch output is
+    bitwise invariant to what the other slots contain. With PBT off
+    the per-member program is the SAME ``_epoch_body`` the
+    single-learner loop compiles, so a population epoch is N stacked
+    single-learner epochs (collect/replay/PRNG/loss streams bitwise;
+    parameter trajectories agree to float-accumulation order, which
+    vmap's batched backward matmuls may legally reassociate).
+
+    With ``pbt=True``, per-member hyperparameters (learning rates,
+    alpha or target entropy, TD3 target noise — see
+    ``SAC.default_hyperparams``) ride ``TrainState.hyperparams`` as
+    traced arrays, and :meth:`pbt_step` runs the Jaderberg-style
+    exploit/explore entirely on device: rank by the return EMA, copy
+    params + optimizer state from top-quantile to bottom-quantile
+    members, multiplicatively perturb the losers' hyperparameters.
+    """
+
+    def __init__(
+        self, sac: SAC, env_cls, n_members: int, n_envs: int = 16,
+        pbt: bool = False,
+    ):
+        if n_members < 1:
+            raise ValueError(f"n_members must be >= 1, got {n_members}")
+        self.sac = sac
+        self.env = env_cls
+        self.n_members = n_members
+        self.n_envs = n_envs
+        self.pbt = pbt
+        self.inner = OnDeviceLoop(sac, env_cls, n_envs=n_envs)
+        self._epoch_fns: dict = {}
+        self._pbt_fn = None
+        self._ema_fn = None
+
+    # ------------------------------------------------------------------ init
+
+    def init(self, key: jax.Array, buffer_capacity: int = 1_000_000):
+        """Member-stacked ``(train_state, buffer, env_states, act_keys,
+        pbt_state)``. The root key fans out to ``n_members`` member
+        keys, and each member's init is EXACTLY the single-learner
+        :meth:`OnDeviceLoop.init` key discipline — so member ``i`` of a
+        population equals a lone ``OnDeviceLoop`` seeded with member
+        key ``i`` (the equivalence the tests pin). ``buffer_capacity``
+        is per member: total replay HBM scales with N."""
+        obs_spec, zero_obs = _env_obs_spec(self.env)
+        from torch_actor_critic_tpu.buffer.replay import (
+            warn_if_buffer_exceeds_hbm,
+        )
+
+        warn_if_buffer_exceeds_hbm(
+            buffer_capacity * self.n_members, obs_spec, self.env.act_dim,
+            advice="reduce buffer_capacity (or population)",
+        )
+        env = self.env
+        n_envs = self.n_envs
+
+        def member_init(k):
+            k_state, k_envs, k_act = jax.random.split(k, 3)
+            ts = self.sac.init_state(k_state, zero_obs)
+            buf = init_replay_buffer(
+                buffer_capacity, obs_spec, env.act_dim
+            )
+            es = jax.vmap(env.reset)(jax.random.split(k_envs, n_envs))
+            return ts, buf, es, k_act
+
+        member_keys = jax.random.split(key, self.n_members)
+        state, buffer, env_states, act_keys = jax.jit(
+            jax.vmap(member_init)
+        )(member_keys)
+        if self.pbt:
+            state = state.replace(
+                hyperparams=self._init_hyperparams(
+                    jax.random.fold_in(key, 0x9B7)
+                )
+            )
+        pbt_state = PBTState(
+            return_ema=jnp.zeros(self.n_members, jnp.float32),
+            ema_count=jnp.zeros(self.n_members, jnp.int32),
+            rng=jax.random.fold_in(key, 0x9B8),
+        )
+        return state, buffer, env_states, act_keys, pbt_state
+
+    def _init_hyperparams(self, key: jax.Array):
+        """Per-member starting hyperparameters: the configured base
+        values log-uniformly jittered within one explore step
+        (``pbt_perturb^U[-1,1]``) so the population begins diverse —
+        exploit then reallocates members toward what works."""
+        base = self.sac.default_hyperparams()
+        perturb = float(self.sac.config.pbt_perturb)
+        hp = {}
+        for i, k in enumerate(sorted(base)):
+            u = jax.random.uniform(
+                jax.random.fold_in(key, i), (self.n_members,),
+                minval=-1.0, maxval=1.0,
+            )
+            hp[k] = base[k] * perturb ** u
+        return hp
+
+    # ----------------------------------------------------------------- epoch
+
+    def _build_epoch(self, steps: int, update_every: int, warmup: bool):
+        n_windows, rem = divmod(steps, update_every)
+        if rem:
+            raise ValueError(
+                f"steps={steps} not a multiple of update_every={update_every}"
+            )
+        inner = self.inner
+
+        def member_epoch(ts, buf, es, key):
+            return inner._epoch_body(
+                ts, buf, es, key, n_windows, update_every, warmup
+            )
+
+        def epoch(state, buffer, env_states, act_keys):
+            state, buffer, env_states, act_keys, raw = jax.vmap(
+                member_epoch
+            )(state, buffer, env_states, act_keys)
+            # _finalize_metrics is elementwise, so it maps over the
+            # member axis unchanged: every metric keeps shape (N,) — N
+            # real learning curves, never one averaged one.
+            return (
+                state, buffer, env_states, act_keys,
+                OnDeviceLoop._finalize_metrics(raw),
+            )
+
+        return jax.jit(epoch, donate_argnums=(0, 1))
+
+    def epoch(
+        self,
+        state: TrainState,
+        buffer: BufferState,
+        env_states: EnvState,
+        act_keys: jax.Array,
+        steps: int,
+        update_every: int = 50,
+        warmup: bool = False,
+    ):
+        """One population epoch: ``steps`` vectorized env steps times
+        ``n_envs`` envs times ``n_members`` members, with a fused
+        gradient burst per ``update_every`` window per member — one
+        device dispatch for everything."""
+        sig = (steps, update_every, warmup)
+        if sig not in self._epoch_fns:
+            self._epoch_fns[sig] = self._build_epoch(*sig)
+        return self._epoch_fns[sig](state, buffer, env_states, act_keys)
+
+    # ------------------------------------------------------------------- pbt
+
+    def update_ema(self, pbt_state: PBTState, metrics: Metrics) -> PBTState:
+        """Fold an epoch's per-member mean returns into the ranking
+        EMA (device-side; inputs are the epoch's output arrays, so no
+        host round-trip). Members with no finished episodes this epoch
+        keep their estimate unchanged and uncounted."""
+        if self._ema_fn is None:
+            tau = float(self.sac.config.pbt_ema)
+
+            def f(ps, episodes, reward):
+                has = episodes > 0
+                blended = jnp.where(
+                    ps.ema_count == 0,
+                    reward,
+                    (1.0 - tau) * ps.return_ema + tau * reward,
+                )
+                return ps.replace(
+                    # reward is NaN for no-episode members; the where()
+                    # keeps their old EMA (NaN never selected).
+                    return_ema=jnp.where(has, blended, ps.return_ema),
+                    ema_count=ps.ema_count + has.astype(jnp.int32),
+                )
+
+            self._ema_fn = jax.jit(f)
+        return self._ema_fn(
+            pbt_state, metrics["episodes"], metrics["reward"]
+        )
+
+    def pbt_step(self, state: TrainState, pbt_state: PBTState):
+        """One exploit/explore step, entirely in-graph.
+
+        Rank members by ``return_ema``; every bottom-quantile member
+        copies params + ALL optimizer state from a uniformly drawn
+        top-quantile member (one gather along the member axis — no
+        host transfer) and multiplies each of its hyperparameters by
+        ``pbt_perturb`` or ``1/pbt_perturb`` (fair coin each). Members
+        keep their own PRNG streams (copying them would correlate the
+        'independent' continuations) and their own replay rings (the
+        winner's policy re-fills the loser's ring within a window).
+        Exploit is identity until every member has a ranked EMA.
+
+        Returns ``(state, pbt_state, event)`` where ``event`` holds
+        the per-member source index, exploit mask, perturbation
+        factors and the ranking EMA — small arrays the host fetches
+        for the ``pbt`` telemetry record.
+        """
+        if self._pbt_fn is None:
+            cfg = self.sac.config
+            n = self.n_members
+            n_cut = max(1, int(n * cfg.pbt_quantile))
+            perturb = float(cfg.pbt_perturb)
+
+            def f(st, ps):
+                ready = jnp.all(ps.ema_count > 0)
+                order = jnp.argsort(ps.return_ema)  # ascending
+                bottom, top = order[:n_cut], order[n - n_cut:]
+                rng, k_pick, k_fac = jax.random.split(ps.rng, 3)
+                pick = jax.random.randint(k_pick, (n_cut,), 0, n_cut)
+                src = jnp.arange(n).at[bottom].set(top[pick])
+                src = jnp.where(ready, src, jnp.arange(n))
+                exploited = src != jnp.arange(n)
+                copied = jax.tree_util.tree_map(lambda x: x[src], st)
+                hp = st.hyperparams
+                factors = perturb ** jax.random.choice(
+                    k_fac, jnp.array([-1.0, 1.0]),
+                    (max(len(hp or {}), 1), n),
+                )
+                if hp is not None:
+                    hp = {
+                        k: jnp.where(
+                            exploited, hp[k][src] * factors[i], hp[k]
+                        )
+                        for i, k in enumerate(sorted(hp))
+                    }
+                new_state = copied.replace(
+                    # step is lockstep-identical across members; rng
+                    # and hyperparams must NOT be the winner's copies.
+                    step=st.step, rng=st.rng, hyperparams=hp,
+                )
+                event = {
+                    "src": src,
+                    "exploited": exploited,
+                    "factors": factors,
+                    "return_ema": ps.return_ema,
+                    "ready": ready,
+                }
+                # Losers inherit the winner's EMA: a freshly cloned
+                # member must compete as its new self, not be
+                # re-exploited next round on its old score.
+                new_ps = ps.replace(
+                    return_ema=jnp.where(
+                        exploited, ps.return_ema[src], ps.return_ema
+                    ),
+                    rng=rng,
+                )
+                return new_state, new_ps, event
+
+            # No donation: the step runs once per pbt_every epochs and
+            # callers (tests, the telemetry path) still read the
+            # pre-exploit state afterwards.
+            self._pbt_fn = jax.jit(f)
+        return self._pbt_fn(state, pbt_state)
+
+    # ----------------------------------------------------------- extraction
+
+    def extract_member(self, state: TrainState, member: int) -> TrainState:
+        """Member ``member``'s complete single-learner state (leading
+        population axis sliced off every leaf) — loadable by the
+        single-learner loop, the eval CLI and the serving plane."""
+        return jax.tree_util.tree_map(lambda x: x[member], state)
+
+
 def _env_obs_spec(env_cls):
     """Resolve an on-device env's observation spec and a zero example.
 
@@ -474,6 +767,190 @@ def train_on_device(
             raise FloatingPointError(f"loss_q diverged at epoch {e}: {metrics}")
     if checkpointer is not None:
         checkpointer.wait()
+    return metrics
+
+
+def train_population_on_device(
+    env_name: str,
+    config,
+    mesh=None,
+    tracker=None,
+    checkpointer=None,
+    seed: int = 0,
+    telemetry=None,
+) -> dict:
+    """Host driver for population-fused training: each epoch is ONE
+    device dispatch advancing ``config.population`` complete learning
+    curves; host work = logging, checkpoints and the (device-computed)
+    PBT cadence. The CLI routes here for ``--on-device true
+    --population N``.
+
+    Per-member metrics flow to the tracker under the suffix-keyed
+    member layout (``loss_q_m3``, ``reward_m7``, ... — see
+    ``diagnostics.split_member_metrics``), so metrics.jsonl carries N
+    curves. Checkpoints are population-aware: the stacked
+    ``TrainState`` (with per-member hyperparams), the stacked replay
+    rings, every member's env state, acting key and PBT bookkeeping —
+    a resumed run continues bitwise (the fused-loop extension of the
+    PR 2 lossless-resume guarantee). ``pbt`` telemetry events record
+    every exploit/explore step.
+    """
+    import numpy as np
+
+    from torch_actor_critic_tpu.diagnostics.ingraph import (
+        split_member_metrics,
+    )
+    from torch_actor_critic_tpu.envs.ondevice import (
+        ON_DEVICE_ENVS,
+        get_on_device_env,
+    )
+    from torch_actor_critic_tpu.parallel.distributed import is_coordinator
+
+    if mesh is not None and int(np.prod(list(mesh.shape.values()))) > 1:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "the population-fused loop is single-device for now — the "
+            "member axis is the parallelism axis; ignoring the %s-device "
+            "mesh and running the whole population on %s (shard members "
+            "over chips by running one process per device)",
+            int(np.prod(list(mesh.shape.values()))), jax.devices()[0],
+        )
+    env_cls = get_on_device_env(env_name)
+    if env_cls is None:
+        raise ValueError(
+            f"{env_name!r} has no pure-JAX twin; on-device training "
+            f"supports {sorted(ON_DEVICE_ENVS)}"
+        )
+    env_cls, sac = _wrap_and_build(env_cls, config)
+    loop = PopulationOnDeviceLoop(
+        sac, env_cls, n_members=config.population,
+        n_envs=config.on_device_envs, pbt=config.pbt_every > 0,
+    )
+    state, buffer, env_states, act_keys, pbt_state = loop.init(
+        jax.random.key(seed), buffer_capacity=config.buffer_size
+    )
+    start_epoch = 0
+    if checkpointer is not None and checkpointer.latest_epoch() is not None:
+        state, buffer, meta, arrays = checkpointer.restore(
+            state, buffer,
+            abstract_arrays={
+                "env_states": env_states,
+                "act_keys": act_keys,
+                "pbt_state": pbt_state,
+            },
+        )
+        saved_pop = int(meta.get("population", 1))
+        if saved_pop != config.population:
+            raise ValueError(
+                f"checkpoint holds a population of {saved_pop}; this "
+                f"run is configured for {config.population}"
+            )
+        if arrays is not None:
+            env_states = arrays["env_states"]
+            act_keys = arrays["act_keys"]
+            pbt_state = arrays["pbt_state"]
+        start_epoch = int(meta["epoch"]) + 1
+
+    def save(epoch: int):
+        checkpointer.save(
+            epoch, state, buffer,
+            extra={
+                "config": config.to_json(),
+                "population": config.population,
+                "pbt": {
+                    "return_ema": np.asarray(
+                        pbt_state.return_ema
+                    ).tolist(),
+                    "ema_count": np.asarray(
+                        pbt_state.ema_count
+                    ).tolist(),
+                },
+            },
+            arrays={
+                "env_states": env_states,
+                "act_keys": act_keys,
+                "pbt_state": pbt_state,
+            },
+        )
+
+    n_warmup = warmup_steps(config.start_steps, config.update_every)
+    if start_epoch == 0:
+        state, buffer, env_states, act_keys, _ = loop.epoch(
+            state, buffer, env_states, act_keys, steps=n_warmup,
+            update_every=config.update_every, warmup=True,
+        )
+
+    import time
+
+    n_members = config.population
+    metrics: dict = {}
+    for e in range(start_epoch, start_epoch + config.epochs):
+        t0 = time.time()
+        state, buffer, env_states, act_keys, m = loop.epoch(
+            state, buffer, env_states, act_keys,
+            steps=config.steps_per_epoch,
+            update_every=config.update_every,
+        )
+        pbt_state = loop.update_ema(pbt_state, m)
+        pbt_event = None
+        # Cadence on the ABSOLUTE epoch: a resumed run exploits at the
+        # same epochs the uninterrupted run would have (part of the
+        # bitwise-resume contract).
+        if config.pbt_every > 0 and (e + 1) % config.pbt_every == 0:
+            state, pbt_state, pbt_event = loop.pbt_step(state, pbt_state)
+        # Host-fetch drain before reading the clock (see train_on_device).
+        drain(m["loss_q"])
+        dt = time.time() - t0
+        # N per-member curves + the suffix-keyed aggregates.
+        metrics = split_member_metrics(jax.device_get(m))
+        metrics["env_steps_per_sec"] = (
+            config.steps_per_epoch * loop.n_envs * n_members / dt
+        )
+        metrics["grad_steps_per_sec"] = (
+            (config.steps_per_epoch // config.update_every)
+            * config.updates_per_window * n_members / dt
+        )
+        if pbt_event is not None:
+            ev = jax.device_get(pbt_event)
+            exploited = np.flatnonzero(ev["exploited"])
+            metrics["pbt_exploits"] = int(exploited.size)
+            if telemetry is not None:
+                hp = jax.device_get(state.hyperparams) or {}
+                telemetry.event(
+                    "pbt",
+                    epoch=e,
+                    exploited=[int(i) for i in exploited],
+                    src=[int(s) for s in ev["src"]],
+                    ready=bool(ev["ready"]),
+                    return_ema=[
+                        round(float(x), 4) for x in ev["return_ema"]
+                    ],
+                    hyperparams={
+                        k: [float(x) for x in np.asarray(v)]
+                        for k, v in hp.items()
+                    },
+                )
+        if tracker is not None and is_coordinator():
+            tracker.log_metrics(metrics, e)
+        if checkpointer is not None and (
+            e % config.save_every == 0
+            or e == start_epoch + config.epochs - 1
+        ):
+            save(e)
+        bad = [
+            i for i in range(n_members)
+            if not np.isfinite(metrics.get(f"loss_q_m{i}", 0.0))
+        ]
+        if bad:
+            raise FloatingPointError(
+                f"loss_q diverged at epoch {e} for members {bad}: "
+                f"{ {k: v for k, v in metrics.items() if 'loss_q' in k} }"
+            )
+    if checkpointer is not None:
+        checkpointer.wait()
+    if telemetry is not None:
+        telemetry.close()
     return metrics
 
 

@@ -61,6 +61,7 @@ from torch_actor_critic_tpu.utils.config import SACConfig
 from torch_actor_critic_tpu.utils.normalize import (
     FeaturesNormalizer,
     IdentityNormalizer,
+    PerMemberNormalizer,
     WelfordNormalizer,
 )
 from torch_actor_critic_tpu.utils.sync import drain
@@ -398,8 +399,29 @@ class Trainer:
         flat_obs = (
             not self.visual and len(self.pool.obs_spec.shape) == 1
         )
-        if self.config.normalize_observations and flat_obs:
+        if (
+            self.config.normalize_observations
+            and flat_obs
+            and self.population > 1
+        ):
+            # One Welford estimate PER MEMBER: pooling would couple the
+            # independent seeds through their input scaling (this
+            # combination used to be rejected outright).
+            self.normalizer = PerMemberNormalizer(
+                self.population, self.pool.obs_spec.shape[0]
+            )
+        elif self.config.normalize_observations and flat_obs:
             self.normalizer = WelfordNormalizer(self.pool.obs_spec.shape[0])
+        elif self.config.normalize_observations and self.population > 1:
+            # Visual/history population: per-member feature statistics
+            # are not wired — run unnormalized rather than pool.
+            logger.warning(
+                "normalize_observations=True ignored for population > 1 "
+                "with obs spec %s: only flat observations have a "
+                "per-member normalizer; running unnormalized",
+                self.pool.obs_spec,
+            )
+            self.normalizer = IdentityNormalizer()
         elif self.config.normalize_observations and isinstance(
             self.pool.obs_spec, MultiObservation
         ):
@@ -545,10 +567,10 @@ class Trainer:
 
     # ------------------------------------------------------------ helpers
 
-    def _normalize(self, obs, update: bool):
+    def _normalize(self, obs, update: bool, member: int | None = None):
         if isinstance(self.normalizer, IdentityNormalizer):
             return obs
-        return self.normalizer.normalize(obs, update=update)
+        return self.normalizer.normalize(obs, update=update, member=member)
 
     def _policy_actions(self, obs_batch, deterministic=False) -> np.ndarray:
         self._act_key, sub = jax.random.split(self._act_key)
@@ -825,6 +847,11 @@ class Trainer:
                             self._normalize(
                                 self.pool.reset_at(i, seed=reset_seed),
                                 update=True,
+                                # Per-member stats under population mode
+                                # (env slot i IS member i there).
+                                member=(
+                                    i if self.population > 1 else None
+                                ),
                             ),
                         )
                     ep_ret[ended] = 0.0
@@ -1292,7 +1319,8 @@ class Trainer:
         for slot in range(n):
             ep_seed = None if seed is None else seed + 0
             o = self._normalize(
-                self.pool.reset_at(slot, seed=ep_seed), update=False
+                self.pool.reset_at(slot, seed=ep_seed), update=False,
+                member=slot,
             )
             obs.append(o)
             rets.append(0.0)
@@ -1307,7 +1335,7 @@ class Trainer:
                 o, r, terminated, truncated = self.pool.step_at(
                     slot, actions[slot]
                 )
-                obs[slot] = self._normalize(o, update=False)
+                obs[slot] = self._normalize(o, update=False, member=slot)
                 rets[slot] += r
                 lens[slot] += 1
                 if render and self._render_ok:
@@ -1326,6 +1354,7 @@ class Trainer:
                         obs[slot] = self._normalize(
                             self.pool.reset_at(slot, seed=ep_seed),
                             update=False,
+                            member=slot,
                         )
                         rets[slot], lens[slot] = 0.0, 0
         all_returns = [r for m in member_returns for r in m]

@@ -34,6 +34,7 @@ from torch_actor_critic_tpu.ops.polyak import polyak_update
 from torch_actor_critic_tpu.sac.algorithm import (
     Metrics,
     _shared_diagnostics,
+    dynamic_lr_step,
     run_update_burst,
 )
 from torch_actor_critic_tpu.td3 import losses
@@ -73,6 +74,17 @@ class TD3:
         self.act_limit = float(getattr(actor_def, "act_limit", 1.0))
         self.pi_tx = optax.adam(config.lr)
         self.q_tx = optax.adam(config.lr)
+        self._adam_core = optax.scale_by_adam()
+
+    def default_hyperparams(self) -> t.Dict[str, jax.Array]:
+        """PBT-perturbable hyperparameters (cf. SAC's): the two
+        learning rates plus the target-policy smoothing noise std —
+        TD3's temperature-analogue regularizer."""
+        return {
+            "actor_lr": jnp.float32(self.config.lr),
+            "critic_lr": jnp.float32(self.config.lr),
+            "target_noise": jnp.float32(self.config.target_noise),
+        }
 
     # ------------------------------------------------------------------ init
 
@@ -137,6 +149,9 @@ class TD3:
         """
         cfg = self.config
         tier = cfg.diagnostics
+        # Per-run hyperparameters (PBT) — see the matching note in
+        # sac/algorithm.py.
+        hp = state.hyperparams if state.hyperparams is not None else {}
         if cfg.frame_augment != "none":
             rng, key_q, key_aug = jax.random.split(state.rng, 3)
             batch = augment_batch(
@@ -159,7 +174,7 @@ class TD3:
             batch=batch,
             key=key_q,
             act_limit=self.act_limit,
-            target_noise=cfg.target_noise,
+            target_noise=hp.get("target_noise", cfg.target_noise),
             noise_clip=cfg.noise_clip,
             gamma=cfg.gamma,
             reward_scale=cfg.reward_scale,
@@ -172,8 +187,9 @@ class TD3:
             diag_metrics["diag/grad_norm_q"] = diag.global_norm(q_grads)
         if axis_name is not None:
             q_grads = jax.lax.pmean(q_grads, axis_name)
-        q_updates, q_opt_state = self.q_tx.update(
-            q_grads, state.q_opt_state, state.critic_params
+        q_updates, q_opt_state = dynamic_lr_step(
+            self._adam_core, self.q_tx, q_grads, state.q_opt_state,
+            state.critic_params, hp.get("critic_lr"),
         )
         critic_params = optax.apply_updates(state.critic_params, q_updates)
         if tier != "off":
@@ -202,8 +218,9 @@ class TD3:
             diag_metrics["diag/grad_norm_pi"] = diag.global_norm(pi_grads)
         if axis_name is not None:
             pi_grads = jax.lax.pmean(pi_grads, axis_name)
-        pi_updates, pi_opt_new = self.pi_tx.update(
-            pi_grads, state.pi_opt_state, state.actor_params
+        pi_updates, pi_opt_new = dynamic_lr_step(
+            self._adam_core, self.pi_tx, pi_grads, state.pi_opt_state,
+            state.actor_params, hp.get("actor_lr"),
         )
         actor_new = optax.apply_updates(state.actor_params, pi_updates)
         if tier != "off":
@@ -237,6 +254,7 @@ class TD3:
             log_alpha=state.log_alpha,
             alpha_opt_state=state.alpha_opt_state,
             rng=rng,
+            hyperparams=state.hyperparams,
         )
         metrics = {
             "loss_q": loss_q,

@@ -178,13 +178,6 @@ def main(argv=None):
             profile_epochs=profile_window,
         )
     if config.on_device:
-        if telemetry_rec is not None:
-            logger.warning(
-                "telemetry/--profile-epochs are host-Trainer features; "
-                "the fused on-device loop (--on-device true) has no "
-                "host-visible phases to span — use --profile for a "
-                "whole-run trace instead"
-            )
         if config.diagnostics != "off":
             logger.warning(
                 "--diagnostics is a host-Trainer feature; the fused "
@@ -192,6 +185,33 @@ def main(argv=None):
                 "in-graph diagnostic reductions would be dead code "
                 "(XLA eliminates them) — running effectively at "
                 "diagnostics=off"
+            )
+        if config.population > 1:
+            # Population-fused path: one dispatch advances N complete
+            # learning curves; PBT exploit/explore events stream to
+            # telemetry.jsonl when --telemetry true.
+            from torch_actor_critic_tpu.sac.ondevice import (
+                train_population_on_device,
+            )
+
+            logger.info(
+                "population-fused on-device training: %s x %d members "
+                "(run %s)",
+                env_name, config.population, tracker.run_id,
+            )
+            metrics = train_population_on_device(
+                env_name, config,
+                mesh=mesh, tracker=tracker, checkpointer=checkpointer,
+                seed=args.seed, telemetry=telemetry_rec,
+            )
+            logger.info("final metrics: %s", metrics)
+            return metrics
+        if telemetry_rec is not None:
+            logger.warning(
+                "telemetry/--profile-epochs are host-Trainer features; "
+                "the fused on-device loop (--on-device true) has no "
+                "host-visible phases to span — use --profile for a "
+                "whole-run trace instead"
             )
         from torch_actor_critic_tpu.sac.ondevice import train_on_device
 

@@ -23,6 +23,7 @@ import typing as t
 from pathlib import Path
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from torch_actor_critic_tpu.core.types import BufferState, TrainState
@@ -138,8 +139,16 @@ class Checkpointer:
         buffer_state: BufferState | None = None,
         extra: t.Mapping[str, t.Any] | None = None,
         wait: bool = False,
+        arrays: t.Any = None,
     ) -> None:
-        """Write checkpoint for ``epoch`` (async unless ``wait``)."""
+        """Write checkpoint for ``epoch`` (async unless ``wait``).
+
+        ``arrays`` is an optional extra array pytree for state that is
+        neither ``TrainState`` nor replay — the population-fused loop
+        persists its member env states, acting keys and PBT
+        bookkeeping here so resume continues bitwise. Typed PRNG-key
+        leaves round-trip like the train state's.
+        """
         items = {
             "train_state": ocp.args.StandardSave(_unwrap_prng_keys(train_state)),
             "meta": ocp.args.JsonSave(
@@ -148,6 +157,8 @@ class Checkpointer:
         }
         if buffer_state is not None and self.save_buffer:
             items["buffer"] = ocp.args.StandardSave(buffer_state)
+        if arrays is not None:
+            items["arrays"] = ocp.args.StandardSave(_unwrap_prng_keys(arrays))
         self._retry(
             lambda: self._mgr.save(epoch, args=ocp.args.Composite(**items)),
             what=f"checkpoint save (epoch {epoch})",
@@ -213,8 +224,14 @@ class Checkpointer:
         abstract_buffer: BufferState | None = None,
         epoch: int | None = None,
         meta_probe: dict | None = None,
+        abstract_arrays: t.Any = None,
     ) -> t.Tuple[TrainState, BufferState | None, dict]:
         """Restore ``(train_state, buffer_state, meta)``.
+
+        With ``abstract_arrays`` given, returns a 4-tuple whose last
+        element is the restored extra-array pytree (``None`` when the
+        checkpoint predates the ``arrays`` item) — the counterpart of
+        :meth:`save`'s ``arrays``.
 
         Abstract pytrees come from ``jax.eval_shape`` over the init
         functions (plus shardings); buffer restore is skipped if the
@@ -232,7 +249,8 @@ class Checkpointer:
         """
         if epoch is not None:
             return self._restore_at(
-                epoch, abstract_train_state, abstract_buffer, meta_probe
+                epoch, abstract_train_state, abstract_buffer, meta_probe,
+                abstract_arrays,
             )
         last_err: Exception | None = None
         tried = 0
@@ -245,6 +263,7 @@ class Checkpointer:
                     # The probe the caller took describes the newest
                     # valid epoch only; older fallback epochs re-probe.
                     meta_probe if tried == 0 else None,
+                    abstract_arrays,
                 )
             except CheckpointFormatError:
                 raise  # every epoch shares the writer's format
@@ -267,6 +286,7 @@ class Checkpointer:
         abstract_train_state: TrainState,
         abstract_buffer: BufferState | None,
         meta_probe: dict | None,
+        abstract_arrays: t.Any = None,
     ) -> t.Tuple[TrainState, BufferState | None, dict]:
         # Check the format version BEFORE the array restore, so a layout
         # change surfaces as this message instead of an opaque Orbax
@@ -314,6 +334,10 @@ class Checkpointer:
             absl_logger.setLevel(prev_level)
         if abstract_buffer is not None and "buffer" in saved_items:
             items["buffer"] = ocp.args.StandardRestore(abstract_buffer)
+        if abstract_arrays is not None and "arrays" in saved_items:
+            items["arrays"] = ocp.args.StandardRestore(
+                _unwrap_prng_keys(abstract_arrays)
+            )
         out = self._retry(
             lambda: self._mgr.restore(
                 epoch, args=ocp.args.Composite(**items)
@@ -323,7 +347,12 @@ class Checkpointer:
         train_state = _rewrap_prng_keys(
             out["train_state"], abstract_train_state
         )
-        return train_state, out.get("buffer"), dict(out["meta"])
+        if abstract_arrays is None:
+            return train_state, out.get("buffer"), dict(out["meta"])
+        arrays = out.get("arrays")
+        if arrays is not None:
+            arrays = _rewrap_prng_keys(arrays, abstract_arrays)
+        return train_state, out.get("buffer"), dict(out["meta"]), arrays
 
     def restore_actor_params(
         self, epoch: int | None = None
@@ -401,3 +430,125 @@ class Checkpointer:
 
     def close(self) -> None:
         self._mgr.close()
+
+
+# ------------------------------------------------------- population export
+
+
+def extract_member(tree: t.Any, member: int) -> t.Any:
+    """Slice one member off every leaf's leading population axis —
+    a stacked population ``TrainState`` (or raw checkpoint dict)
+    becomes the single-learner state of member ``member``."""
+    return jax.tree_util.tree_map(lambda x: x[member], tree)
+
+
+def export_member_checkpoint(
+    src_directory: str | Path,
+    dst_directory: str | Path,
+    member: int | None = None,
+    epoch: int | None = None,
+) -> t.Tuple[int, int]:
+    """Export ONE member of a population checkpoint as a standalone
+    single-learner checkpoint — the population -> serving bridge: the
+    result restores through :meth:`Checkpointer.restore_actor_params`,
+    so ``serve.py`` (and its hot-reload poller) can serve the winner
+    of a PBT run directly.
+
+    ``member=None`` picks the best member by the checkpoint's recorded
+    PBT return EMA (falling back to member 0 when the run kept no
+    ranking). Like :meth:`restore_actor_params` this is shape-from-disk:
+    no abstract tree needed, and the replay rings are never touched.
+    Returns ``(member, epoch)`` actually exported.
+    """
+    src = Checkpointer(src_directory, save_buffer=False)
+    try:
+        if epoch is None:
+            epoch = src.latest_epoch()
+        if epoch is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {src.directory}"
+            )
+        import logging as _logging
+
+        absl_logger = _logging.getLogger("absl")
+        prev_level = absl_logger.level
+        absl_logger.setLevel(_logging.ERROR)
+        try:
+            out = src._retry(
+                lambda: src._mgr.restore(
+                    epoch,
+                    args=ocp.args.Composite(
+                        train_state=ocp.args.StandardRestore(),
+                        meta=ocp.args.JsonRestore(),
+                    ),
+                ),
+                what=f"population restore (epoch {epoch})",
+            )
+        finally:
+            absl_logger.setLevel(prev_level)
+        meta = dict(out["meta"])
+        population = int(meta.get("population", 1))
+        if population < 2:
+            raise ValueError(
+                f"checkpoint at {src.directory} epoch {epoch} is not a "
+                f"population checkpoint (population={population})"
+            )
+        if member is None:
+            ema = (meta.get("pbt") or {}).get("return_ema")
+            member = int(np.argmax(ema)) if ema else 0
+        if not 0 <= member < population:
+            raise ValueError(
+                f"member {member} out of range for population "
+                f"{population}"
+            )
+        member_state = extract_member(out["train_state"], member)
+    finally:
+        src.close()
+
+    extra = {
+        k: v for k, v in meta.items()
+        if k not in ("epoch", "ckpt_format", "population", "pbt")
+    }
+    if "config" in extra:
+        from torch_actor_critic_tpu.utils.config import SACConfig
+
+        extra["config"] = SACConfig.from_json(extra["config"]).replace(
+            population=1, pbt_every=0
+        ).to_json()
+    extra["exported_member"] = member
+    extra["source_population"] = population
+    dst = Checkpointer(dst_directory, save_buffer=False)
+    try:
+        dst.save(epoch, member_state, extra=extra, wait=True)
+    finally:
+        dst.close()
+    return member, epoch
+
+
+def _export_member_main(argv=None):
+    """CLI: ``python -m torch_actor_critic_tpu.utils.checkpoint SRC DST
+    [--member I] [--epoch E]`` — export a (best-by-default) population
+    member for serving (docs/SCALING.md "Population training")."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Export one member of a population checkpoint as a "
+        "standalone single-learner checkpoint."
+    )
+    p.add_argument("src", help="population checkpoint directory")
+    p.add_argument("dst", help="output checkpoint directory")
+    p.add_argument(
+        "--member", type=int, default=None,
+        help="member index (default: best by PBT return EMA)",
+    )
+    p.add_argument("--epoch", type=int, default=None)
+    args = p.parse_args(argv)
+    member, epoch = export_member_checkpoint(
+        args.src, args.dst, member=args.member, epoch=args.epoch
+    )
+    print(f"exported member {member} (epoch {epoch}) -> {args.dst}")
+    return member, epoch
+
+
+if __name__ == "__main__":
+    _export_member_main()

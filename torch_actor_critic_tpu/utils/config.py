@@ -113,7 +113,26 @@ class SACConfig:
     # answer to multi-seed runs (the reference needs N full processes,
     # ref sac/mpi.py:10-34). Each member gets its own host env and its
     # own `buffer_size`-slot ring; metrics carry per-member curves.
+    # Composes with on_device=True: the fused loop vmaps the ENTIRE
+    # epoch program — envs, replay rings, PRNG streams and update
+    # bursts — over the member axis (sac/ondevice.py
+    # PopulationOnDeviceLoop), so N complete learning curves advance
+    # per device dispatch.
     population: int = 1
+
+    # On-device PBT (population-based training) exploit/explore over
+    # the fused population loop: every pbt_every epochs members are
+    # ranked by an in-loop episode-return EMA; each bottom-quantile
+    # member copies params + optimizer state from a random top-quantile
+    # member and multiplicatively perturbs its own hyperparameters
+    # (lrs, alpha/target-entropy, TD3 target noise) by pbt_perturb^±1 —
+    # all in-graph, no host round-trip (Jaderberg et al. 2017).
+    # pbt_every=0 disables (the population stays N fixed-hyperparam
+    # seeds). Requires population > 1 with on_device.
+    pbt_every: int = 0
+    pbt_quantile: float = 0.25  # exploit fraction at each end of the ranking
+    pbt_perturb: float = 1.25   # multiplicative explore factor (>1)
+    pbt_ema: float = 0.5        # EMA weight of each new epoch's mean return
 
     # Observation normalization (the reference ships a Welford
     # normalizer as dead code, ref sac/utils.py:27-65; here it's a
@@ -262,19 +281,33 @@ class SACConfig:
             raise ValueError(
                 f"population must be >= 1, got {self.population}"
             )
-        if self.population > 1 and self.on_device:
+        if self.pbt_every < 0:
             raise ValueError(
-                "population > 1 is a host-Trainer mode; the fused "
-                "on-device loop batches envs per member differently — "
-                "run on_device with population=1"
+                f"pbt_every must be >= 0 (0 = off), got {self.pbt_every}"
             )
-        if self.population > 1 and self.normalize_observations:
+        if self.pbt_every > 0 and self.population < 2:
             raise ValueError(
-                "population > 1 with normalize_observations would pool "
-                "one Welford estimate across members, silently coupling "
-                "the 'independent' seeds through their input scaling; "
-                "per-member normalizers are not wired yet — run the "
-                "population unnormalized"
+                "pbt_every > 0 needs a population to exploit/explore "
+                f"over; got population={self.population}"
+            )
+        if self.pbt_every > 0 and not self.on_device:
+            raise ValueError(
+                "PBT exploit/explore runs in-graph over the fused "
+                "population loop; pass --on-device true (the host-loop "
+                "population trains N fixed-hyperparam seeds)"
+            )
+        if not 0.0 < self.pbt_quantile <= 0.5:
+            raise ValueError(
+                f"pbt_quantile must be in (0, 0.5], got {self.pbt_quantile}"
+            )
+        if self.pbt_perturb <= 1.0:
+            raise ValueError(
+                f"pbt_perturb must be > 1 (multiplicative explore "
+                f"factor), got {self.pbt_perturb}"
+            )
+        if not 0.0 < self.pbt_ema <= 1.0:
+            raise ValueError(
+                f"pbt_ema must be in (0, 1], got {self.pbt_ema}"
             )
         if self.diagnostics not in ("off", "light", "full"):
             raise ValueError(

@@ -34,11 +34,15 @@ class WelfordNormalizer:
         # contribution (see sync_global).
         self._base = (self.mean.copy(), self.m2.copy(), 0)
 
-    def normalize(self, x: np.ndarray, update: bool = True) -> np.ndarray:
+    def normalize(
+        self, x: np.ndarray, update: bool = True, member: int | None = None
+    ) -> np.ndarray:
         """Accepts one observation ``(dim,)`` or a lockstep batch
         ``(n, dim)`` (the vectorized env pool path). The batched update
         is Chan's parallel merge, which reduces exactly to Welford's
-        single-sample recurrence at n=1."""
+        single-sample recurrence at n=1. ``member`` is accepted for
+        interface parity with :class:`PerMemberNormalizer` and ignored:
+        one pooled estimate serves every env slot."""
         x = np.asarray(x, np.float64)
         if update:
             xb = x if x.ndim == 2 else x[None]
@@ -153,7 +157,7 @@ class FeaturesNormalizer:
     def __init__(self, feature_dim: int, eps: float = 1e-8):
         self.inner = WelfordNormalizer(feature_dim, eps)
 
-    def normalize(self, obs, update: bool = True):
+    def normalize(self, obs, update: bool = True, member: int | None = None):
         from torch_actor_critic_tpu.core.types import MultiObservation
 
         return MultiObservation(
@@ -171,10 +175,90 @@ class FeaturesNormalizer:
         self.inner.load_state_dict(d["features"])
 
 
+class PerMemberNormalizer:
+    """One independent Welford estimate PER POPULATION MEMBER.
+
+    Pooling one estimate across a population would couple the
+    "independent" seeds through their input scaling (member i's
+    observations would shift member j's normalization — exactly the
+    leakage the population contract forbids), which is why
+    ``population > 1`` used to reject ``normalize_observations``
+    outright. Here the statistics carry a leading member axis and
+    every update is vectorized across members: a lockstep ``(N, dim)``
+    batch is N single-sample Welford updates, one per member's own
+    estimate, in one numpy op.
+
+    ``member=i`` normalizes a single ``(dim,)`` observation with (and
+    optionally into) member ``i``'s statistics — the reset/eval path,
+    where the trainer touches one member's env at a time. Same
+    ``state_dict``/``sync_global`` surface as
+    :class:`WelfordNormalizer` (populations are single-process, so the
+    cross-host sync is a no-op by construction).
+    """
+
+    def __init__(self, n_members: int, dim: int, eps: float = 1e-8):
+        if n_members < 1:
+            raise ValueError(f"n_members must be >= 1, got {n_members}")
+        self.n_members = n_members
+        self.mean = np.zeros((n_members, dim), np.float64)
+        self.m2 = np.zeros((n_members, dim), np.float64)
+        self.count = np.zeros(n_members, np.int64)
+        self.eps = eps
+
+    def _apply(self, x, idx):
+        var = self.m2[idx] / np.maximum(self.count[idx, None], 1)
+        return ((x - self.mean[idx]) / np.sqrt(var + self.eps)).astype(
+            np.float32
+        )
+
+    def normalize(
+        self, x: np.ndarray, update: bool = True, member: int | None = None
+    ) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        if member is not None:
+            idx = np.array([member])
+            xb = x[None]
+        else:
+            if x.ndim != 2 or x.shape[0] != self.n_members:
+                raise ValueError(
+                    f"expected a ({self.n_members}, dim) member-aligned "
+                    f"batch or member=i with one observation; got shape "
+                    f"{x.shape}"
+                )
+            idx = np.arange(self.n_members)
+            xb = x
+        if update:
+            # Welford single-sample recurrence, vectorized over the
+            # selected members (each row is ONE sample of its member).
+            self.count[idx] += 1
+            delta = xb - self.mean[idx]
+            self.mean[idx] += delta / self.count[idx, None]
+            self.m2[idx] += delta * (xb - self.mean[idx])
+        out = self._apply(xb, idx)
+        return out[0] if member is not None else out
+
+    def sync_global(self) -> None:
+        pass  # populations are single-process (PopulationLearner gate)
+
+    def state_dict(self) -> dict:
+        return {
+            "mean": self.mean.tolist(),
+            "m2": self.m2.tolist(),
+            "count": self.count.tolist(),
+        }
+
+    def load_state_dict(self, d) -> None:
+        self.mean = np.asarray(d["mean"], np.float64)
+        self.m2 = np.asarray(d["m2"], np.float64)
+        self.count = np.asarray(d["count"], np.int64)
+
+
 class IdentityNormalizer:
     """Pass-through (ref ``Identity``, ``sac/utils.py:68-79``)."""
 
-    def normalize(self, x: np.ndarray, update: bool = True) -> np.ndarray:
+    def normalize(
+        self, x: np.ndarray, update: bool = True, member: int | None = None
+    ) -> np.ndarray:
         return np.asarray(x, np.float32)
 
     def sync_global(self) -> None:
