@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke
+.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -16,6 +16,15 @@ test-fast:
 
 bench:
 	python bench.py
+
+# Diff two bench artifacts; nonzero exit on a per-stage regression
+# beyond the noise bar (A/B: raw bench JSON lines from runs/tpu/ or
+# BENCH_rNN capture wrappers — truncated tails are partially
+# recovered). See docs/OBSERVABILITY.md "Cost attribution & roofline".
+A ?= BENCH_r04.json
+B ?= BENCH_r05.json
+bench-diff:
+	python scripts/bench_diff.py $(A) $(B)
 
 # Real-chip smoke: Pallas kernels fwd+bwd, fused burst, on-device env.
 tpu-smoke:
@@ -67,6 +76,14 @@ diag-smoke:
 # of the population checkpoint (docs/SCALING.md "population").
 pop-smoke:
 	JAX_PLATFORMS=cpu python scripts/pop_smoke.py
+
+# Compute-cost attribution smoke: short CPU train with telemetry + an
+# in-process serve round -> every per-epoch `cost` event present and
+# finite, serving /metrics carries per-bucket roofline entries, FLOPs
+# monotone with bucket size, and one cross-plane Perfetto trace holds
+# BOTH planes' spans (docs/OBSERVABILITY.md "Cost attribution").
+cost-smoke:
+	JAX_PLATFORMS=cpu python scripts/cost_smoke.py
 
 # Fault-injection suite: every recovery path (NaN rollback, SIGTERM
 # save+requeue+bitwise resume, checkpoint retry/fallback, dead env

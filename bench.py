@@ -78,6 +78,7 @@ def persist_tpu_artifact(out: dict, prefix: str = "bench") -> str | None:
     record.pop("diagnostics", None)  # transient; keeps artifacts stable
     record.pop("error", None)  # run status, not evidence — a stale
     # error merged under a fresh headline would contradict itself
+    record.pop("stage_errors", None)  # run status too, same reason
     metadata = {"backend", "device_kind", "captured_utc", "metric",
                 "unit", "notes"}
     if not any(k for k, v in record.items()
@@ -160,18 +161,10 @@ def load_last_known_tpu() -> dict | None:
 # vs_baseline only if the live baseline measurement fails.
 TORCH_CPU_FALLBACK_SPS = 143.1
 
-# Peak bf16 FLOP/s per chip by TPU generation (public figures); MFU is
-# reported against the matching entry (override: TAC_PEAK_FLOPS env).
-PEAK_FLOPS_BY_KIND = [
-    ("v6", 918e12),
-    ("trillium", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
+# Peak bf16 FLOP/s per chip generation now lives in ONE place —
+# telemetry/costmodel.py (the live roofline layer shares it); bench's
+# peak_flops_for() below delegates there, TAC_PEAK_FLOPS override
+# included.
 
 # The axon sitecustomize re-registers "axon,cpu" over JAX_PLATFORMS at
 # jax import, so a CPU probe/fallback must force the platform via
@@ -1600,14 +1593,11 @@ def bench_torch_cpu(n_steps=300):
 
 
 def peak_flops_for(device_kind):
-    env = os.environ.get("TAC_PEAK_FLOPS")
-    if env:
-        return float(env)
-    kind = (device_kind or "").lower()
-    for tag, peak in PEAK_FLOPS_BY_KIND:
-        if tag in kind:
-            return peak
-    return None
+    from torch_actor_critic_tpu.telemetry.costmodel import (
+        peak_flops_for as _peak_flops_for,
+    )
+
+    return _peak_flops_for(device_kind)
 
 
 def mfu_metrics(acc_sps, device_kind, flops=None):
@@ -1703,7 +1693,28 @@ def _run_stage_inprocess(name):
     print(json.dumps(result), flush=True)
 
 
-def run_stage_subprocess(name, timeout_s, diagnostics, platform=None):
+def stage_timeout_override():
+    """The per-stage hard-timeout override: ``--stage-timeout=SECS``
+    on the CLI (or ``TAC_BENCH_STAGE_TIMEOUT`` in the env) replaces
+    every stage's default timeout — BENCH_r05's sweep/unroll/td3
+    deaths were opaque 900s strings because the knob did not exist."""
+    for a in sys.argv[1:]:
+        if a.startswith("--stage-timeout="):
+            return float(a.split("=", 1)[1])
+    env = os.environ.get("TAC_BENCH_STAGE_TIMEOUT")
+    return float(env) if env else None
+
+
+# Structured per-stage failure records accumulated across the run and
+# published as the artifact's `stage_errors` key (satellite of the
+# cost-attribution PR): each is {stage, error, elapsed_s, timeout_s,
+# rc?, stderr_tail?, partial_output?}.
+STAGE_ERRORS: list = []
+
+
+def run_stage_subprocess(
+    name, timeout_s, diagnostics, platform=None, stage_errors=None
+):
     """Run a bench stage in a subprocess with a hard timeout.
 
     The round-1 bench died when the TPU backend failed at init; the
@@ -1711,7 +1722,16 @@ def run_stage_subprocess(name, timeout_s, diagnostics, platform=None):
     this round: preflight ok, then every TPU op hangs forever) would
     still wedge the parent. A subprocess + timeout turns any hang into
     a structured diagnostic instead of a lost round.
+
+    Failures append a STRUCTURED record to ``stage_errors`` (stage
+    name, elapsed, timeout, error, and the child's output tails — the
+    per-point ``[bench]`` progress lines are the partial results a
+    killed stage leaves behind) instead of the former opaque
+    ``"timeout after 900s"`` strings merged from partial runs.
     """
+    override = stage_timeout_override()
+    if override is not None:
+        timeout_s = override
     env = dict(os.environ)
     if platform:
         env["TAC_BENCH_CHILD_PLATFORM"] = platform
@@ -1722,6 +1742,26 @@ def run_stage_subprocess(name, timeout_s, diagnostics, platform=None):
         "JAX_COMPILATION_CACHE_DIR",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
     )
+
+    def record(err, proc=None, partial=None):
+        rec = {
+            "stage": name,
+            "error": err,
+            "elapsed_s": round(time.time() - t0, 1),
+            "timeout_s": timeout_s,
+        }
+        if proc is not None:
+            rec["rc"] = proc.returncode
+            if proc.stderr:
+                rec["stderr_tail"] = proc.stderr[-500:]
+        if partial:
+            rec["partial_output"] = partial
+        (stage_errors if stage_errors is not None else STAGE_ERRORS).append(
+            rec
+        )
+        diagnostics.append({f"{name}_stage_error": err})
+
+    t0 = time.time()
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), f"--stage={name}"],
@@ -1730,15 +1770,23 @@ def run_stage_subprocess(name, timeout_s, diagnostics, platform=None):
         line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
         if proc.returncode == 0 and line:
             return json.loads(line)
-        diagnostics.append({
-            f"{name}_stage_rc": proc.returncode,
-            "stderr_tail": proc.stderr[-500:],
-        })
-    except subprocess.TimeoutExpired:
-        diagnostics.append({f"{name}_stage_error": f"timeout after {timeout_s}s"})
-        log(f"stage {name} timed out ({timeout_s}s) — tunnel hang?")
+        record(f"exit code {proc.returncode} with no result line", proc=proc)
+    except subprocess.TimeoutExpired as e:
+        # The kill loses the child's final JSON line; its streamed
+        # stderr progress ([bench] lines per completed point) is the
+        # partial evidence that survives.
+        partial = []
+        for stream in (e.stdout, e.stderr):
+            if stream:
+                text = (
+                    stream.decode(errors="replace")
+                    if isinstance(stream, bytes) else stream
+                )
+                partial.extend(text.strip().splitlines()[-8:])
+        record(f"timeout after {timeout_s:g}s", partial=partial or None)
+        log(f"stage {name} timed out ({timeout_s:g}s) — tunnel hang?")
     except Exception as e:  # noqa: BLE001
-        diagnostics.append({f"{name}_stage_error": repr(e)})
+        record(repr(e))
     return None
 
 
@@ -1912,6 +1960,11 @@ def main():
 
     if diagnostics:
         out["diagnostics"] = diagnostics
+    if STAGE_ERRORS:
+        # Structured per-stage failures (stage, elapsed, timeout,
+        # partial output) — the artifact says WHICH stage died and how
+        # far it got, not just an opaque merged string.
+        out["stage_errors"] = list(STAGE_ERRORS)
     if out["value"] is None:
         out["error"] = "no accelerator benchmark completed"
 

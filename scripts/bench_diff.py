@@ -1,0 +1,189 @@
+"""Diff two bench JSON artifacts and flag per-stage regressions.
+
+    python scripts/bench_diff.py BENCH_r04.json BENCH_r05.json
+    python scripts/bench_diff.py A.json B.json --noise-pct 15
+
+Walks both artifacts' numeric leaves (dotted paths: ``serving.p99_ms``,
+``on_device.pendulum.env_steps_per_sec``, ``sweep[3].mfu``), compares
+every performance-shaped key present in both, and prints the per-key
+delta. Direction-aware: throughput-shaped keys (``*_per_sec``,
+``*tflops``, ``mfu``, ``goodput``, the headline ``value``) regress when
+they DROP; latency-shaped keys (``p50_ms``/``p99_ms``/``*_ms``) regress
+when they RISE. Deltas within ``--noise-pct`` (default 10%) are noise.
+
+Exit status: 0 = no regression beyond the noise bar, 1 = at least one
+(CI-gateable: ``make bench-diff A=... B=...``), 2 = usage/IO error.
+Keys that are not performance metrics (counters, geometry, static
+FLOPs, notes) are ignored rather than producing false alarms.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+# Performance-shaped keys and their regression direction. Matched
+# against the LEAF key name only (paths locate, names classify).
+HIGHER_BETTER = re.compile(
+    r"(per_sec|_rps$|tflops|^mfu$|_mfu$|^est_mfu$|goodput|occupancy"
+    r"|^value$|^value_bf16$|scaling_vs_1|roofline_frac)"
+)
+LOWER_BETTER = re.compile(
+    r"(^p50_ms$|^p95_ms$|^p99_ms$|^mean_ms$|^max_ms$|_ms$"
+    r"|^ms_per_lockstep_round$|overhead.*_pct$)"
+)
+
+
+def load_artifact(path: str):
+    """Load a bench artifact: either a raw bench JSON line (runs/tpu/
+    bench_*.json) or a BENCH_rNN capture wrapper whose ``tail`` holds
+    the (possibly front-truncated) stdout line. Truncated tails are
+    recovered from the first top-level ``, "key":`` resync point —
+    the trailing sections (serving, visual, headline value...) survive
+    even when the line's start was cut. Returns ``(record, partial)``.
+    """
+    with open(path) as f:
+        data = json.load(f)
+    if "metric" in data or "tail" not in data:
+        return data, False
+    tail = data["tail"]
+    start = tail.find('{"metric')
+    if start >= 0:
+        try:
+            return json.loads(tail[start:]), False
+        except json.JSONDecodeError:
+            pass
+    for m in re.finditer(r', "', tail):
+        cand = "{" + tail[m.start() + 2:]
+        # A tail cut inside a NESTED section leaves unmatched trailing
+        # braces; peeling up to three recovers resync points one or two
+        # levels deep (e.g. a tail entirely inside `last_known_tpu`).
+        for strip in range(4):
+            try:
+                rec = json.loads(cand[:len(cand) - strip or None])
+            except json.JSONDecodeError:
+                continue
+            # Leftmost resync wins: a successful parse must consume to
+            # the (peeled) end of the line, so earlier points recover a
+            # superset of later ones.
+            if isinstance(rec, dict) and rec:
+                return rec, True
+            break
+    raise ValueError(
+        f"{path}: neither a bench JSON line nor a recoverable capture "
+        "wrapper"
+    )
+
+
+def numeric_leaves(node, path=""):
+    """Yield (dotted_path, leaf_key, value) for every numeric leaf."""
+    if isinstance(node, dict):
+        for k, v in node.items():
+            yield from numeric_leaves(v, f"{path}.{k}" if path else str(k))
+    elif isinstance(node, list):
+        for i, v in enumerate(node):
+            yield from numeric_leaves(v, f"{path}[{i}]")
+    elif isinstance(node, (int, float)) and not isinstance(node, bool):
+        leaf = path.rsplit(".", 1)[-1]
+        yield path, leaf, float(node)
+
+
+def compare(a: dict, b: dict, noise_pct: float):
+    """(rows, regressions): every compared key with its delta, and the
+    subset regressing beyond the noise bar."""
+    a_leaves = {p: (k, v) for p, k, v in numeric_leaves(a)}
+    rows, regressions = [], []
+    for path, leaf, vb in sorted(numeric_leaves(b)):
+        if path not in a_leaves:
+            continue
+        if HIGHER_BETTER.search(leaf):
+            direction = +1
+        elif LOWER_BETTER.search(leaf):
+            direction = -1
+        else:
+            continue
+        va = a_leaves[path][1]
+        if va == 0:
+            continue
+        delta_pct = (vb - va) / abs(va) * 100.0
+        # A drop in a higher-better key (or a rise in a lower-better
+        # one) beyond the noise bar is a regression.
+        regressed = (-direction * delta_pct) > noise_pct
+        rows.append((path, va, vb, delta_pct, direction, regressed))
+        if regressed:
+            regressions.append(rows[-1])
+    return rows, regressions
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Diff two bench JSON artifacts; nonzero exit on "
+        "regression beyond the noise bar."
+    )
+    p.add_argument("artifact_a", help="older bench JSON (the baseline)")
+    p.add_argument("artifact_b", help="newer bench JSON (the candidate)")
+    p.add_argument(
+        "--noise-pct", type=float, default=10.0,
+        help="deltas within this band are noise, not regressions "
+        "(default 10)",
+    )
+    p.add_argument(
+        "--all", action="store_true",
+        help="print every compared key, not just the regressions",
+    )
+    args = p.parse_args(argv)
+
+    try:
+        a, a_partial = load_artifact(args.artifact_a)
+        b, b_partial = load_artifact(args.artifact_b)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        print(f"bench-diff: cannot load artifacts: {e}", file=sys.stderr)
+        return 2
+    for name, partial in (
+        (args.artifact_a, a_partial), (args.artifact_b, b_partial),
+    ):
+        if partial:
+            print(
+                f"bench-diff: note: {name} is a truncated capture "
+                "wrapper; only its recovered trailing sections are "
+                "compared", file=sys.stderr,
+            )
+
+    rows, regressions = compare(a, b, args.noise_pct)
+    if not rows:
+        print("bench-diff: no comparable performance keys found")
+        return 2
+
+    width = max(len(r[0]) for r in rows)
+    print(
+        f"bench-diff: {args.artifact_a} -> {args.artifact_b} "
+        f"({len(rows)} keys, noise bar {args.noise_pct:g}%)"
+    )
+    print(f"{'key':<{width}}  {'A':>12}  {'B':>12}  {'delta':>8}")
+    shown = rows if args.all else [
+        r for r in rows if r[5] or abs(r[3]) > args.noise_pct
+    ]
+    for path, va, vb, delta, direction, regressed in shown:
+        flag = "REGRESSION" if regressed else (
+            "improved" if (direction * delta) > args.noise_pct else ""
+        )
+        print(
+            f"{path:<{width}}  {va:>12.4g}  {vb:>12.4g}  "
+            f"{delta:>+7.1f}%  {flag}"
+        )
+    if not shown:
+        print(f"(all {len(rows)} compared keys within the noise bar)")
+    if regressions:
+        print(
+            f"bench-diff: {len(regressions)} regression(s) beyond "
+            f"{args.noise_pct:g}%", file=sys.stderr,
+        )
+        return 1
+    print("bench-diff: no regressions beyond the noise bar")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -96,6 +96,12 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                      help="SIGTERM graceful-drain flush budget in "
                           "seconds (answer everything accepted, then "
                           "exit 0)")
+    srv.add_argument("--trace-export", metavar="PATH", default=None,
+                     help="Write a Perfetto (chrome://tracing) trace "
+                          "to PATH at exit: per-request serving spans "
+                          "(queue/collect/forward/respond under their "
+                          "X-Request-Id) plus XLA compile events on "
+                          "one timeline (docs/OBSERVABILITY.md)")
     return p.parse_args(argv)
 
 
@@ -196,6 +202,12 @@ def main(argv=None):
     if args.poll_interval > 0:
         registry.start_polling(args.poll_interval)
 
+    span_log = None
+    if args.trace_export:
+        from torch_actor_critic_tpu.telemetry.traceview import RequestSpanLog
+
+        span_log = RequestSpanLog()
+
     server = PolicyServer(
         registry, host=args.host, port=args.port,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
@@ -203,6 +215,7 @@ def main(argv=None):
         request_timeout_s=args.request_timeout,
         act_timeout_s=args.act_timeout,
         capacity=args.queue_capacity,
+        span_log=span_log,
     )
     # Rolling-restart contract: SIGTERM stops admissions, answers every
     # accepted request, then serve_forever returns and we exit 0.
@@ -210,7 +223,29 @@ def main(argv=None):
     print(json.dumps({
         "serving": server.address, "slots": registry.slots(),
     }), flush=True)
-    server.serve_forever()
+    try:
+        server.serve_forever()
+    finally:
+        if args.trace_export:
+            from torch_actor_critic_tpu.diagnostics.watchdog import (
+                get_watchdog,
+            )
+            from torch_actor_critic_tpu.telemetry.traceview import (
+                compile_events,
+                export_trace,
+                serve_request_events,
+            )
+
+            summary = export_trace(
+                args.trace_export,
+                serve_request_events(span_log.records()),
+                compile_events(get_watchdog().compile_log()),
+            )
+            logger.info(
+                "trace exported to %s (%d request spans) — load at "
+                "chrome://tracing or https://ui.perfetto.dev",
+                summary["path"], summary["serve_spans"],
+            )
 
 
 if __name__ == "__main__":

@@ -34,6 +34,7 @@ docs/OBSERVABILITY.md.
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
@@ -46,6 +47,7 @@ __all__ = ["RecompilationWatchdog", "get_watchdog"]
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _UNATTRIBUTED = "unattributed"
 _MAX_ANOMALIES = 100  # bounded memory; the counter keeps the true total
+_MAX_COMPILE_LOG = 256  # newest per-compile records kept for the trace
 
 
 class _SourceCtx:
@@ -96,6 +98,13 @@ class RecompilationWatchdog:
         self.post_steady_total = 0
         self.anomalies: t.List[dict] = []
         self._steady_prefixes: t.Set[str] = set()
+        # Bounded per-compile record ring (source, end wall time,
+        # duration): the cross-plane trace export draws compile spans
+        # from here (telemetry/traceview.py). Newest-wins, so a long
+        # run keeps the recent window a trace would cover anyway.
+        self._compile_log: collections.deque = collections.deque(
+            maxlen=_MAX_COMPILE_LOG
+        )
 
     # ------------------------------------------------------------ install
 
@@ -151,6 +160,12 @@ class RecompilationWatchdog:
             self.compiles_total += 1
             self.by_source[src] = self.by_source.get(src, 0) + 1
             self.compile_time_s += secs
+            self._compile_log.append({
+                "source": src,
+                "time": time.time(),  # the event fires at compile END
+                "duration_s": round(secs, 4),
+                "expected": expected,
+            })
             steady = not expected and any(
                 src.startswith(p) for p in self._steady_prefixes
             )
@@ -175,6 +190,14 @@ class RecompilationWatchdog:
 
     # ----------------------------------------------------------- reports
 
+    def compile_log(self) -> t.List[dict]:
+        """The newest per-compile records (bounded ring), each
+        ``{source, time, duration_s, expected}`` — the trace export's
+        compile-span source (``time`` is the compile's END on the wall
+        clock)."""
+        with self._lock:
+            return [dict(r) for r in self._compile_log]
+
     def snapshot(self) -> dict:
         """``/metrics``-style view (also embedded in telemetry.jsonl
         epoch events by the Trainer)."""
@@ -197,6 +220,7 @@ class RecompilationWatchdog:
             self.post_steady_total = 0
             self.anomalies = []
             self._steady_prefixes = set()
+            self._compile_log.clear()
 
 
 _WATCHDOG: RecompilationWatchdog | None = None

@@ -369,6 +369,11 @@ class DataParallelSAC:
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
+    # The cost-registry key this learner's burst registers under — the
+    # same source name the recompilation watchdog attributes its
+    # compiles to (telemetry/costmodel.py).
+    burst_cost_name = "train/update_burst"
+
     def update_burst(
         self,
         state: TrainState,
@@ -385,6 +390,14 @@ class DataParallelSAC:
                 self._build_burst(num_updates, buffer, chunk),
             )
         return self._burst[1](state, buffer, chunk)
+
+    def burst_jit(self, num_updates: int):
+        """The cached jitted burst for ``num_updates`` (None before its
+        first dispatch) — the cost registry lowers this with abstract
+        args to read the program's FLOPs/bytes without re-running it."""
+        if self._burst is not None and self._burst[0] == num_updates:
+            return self._burst[1]
+        return None
 
     def push_chunk(self, buffer: BufferState, chunk: Batch) -> BufferState:
         """Store per-device chunks without gradient steps — the warmup

@@ -197,6 +197,15 @@ class PopulationLearner:
         with get_watchdog().source("train/population_burst"):
             return fn(state, buffer, chunk)
 
+    # Cost-registry key: matches the watchdog source scope above.
+    burst_cost_name = "train/population_burst"
+
+    def burst_jit(self, num_updates: int):
+        """The cached jitted population burst (None before its first
+        dispatch) — same cost-registry lowering hook as
+        :meth:`DataParallelSAC.burst_jit`."""
+        return self._bursts.get(num_updates)
+
     def push_chunk(self, buffer: BufferState, chunk: Batch) -> BufferState:
         """Warmup-path store (no gradient steps), vmapped per member."""
         if self._push is None:

@@ -312,6 +312,11 @@ class OnDeviceLoop:
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
+    # Watchdog/cost-registry source name of the fused epoch program —
+    # every compile in epoch() is attributed here, and the driver
+    # registers the program's XLA cost analysis under the same key.
+    epoch_cost_name = "train/ondevice_epoch"
+
     def epoch(
         self,
         train_state: TrainState,
@@ -328,10 +333,21 @@ class OnDeviceLoop:
         with uniform-random actions and skips updates (the reference's
         ``start_steps``/``update_after`` phase, ref
         ``sac/algorithm.py:227-228,273``)."""
+        from torch_actor_critic_tpu.diagnostics.watchdog import get_watchdog
+
         sig = (steps, update_every, warmup)
         if sig not in self._epoch_fns:
             self._epoch_fns[sig] = self._build_epoch(*sig)
-        return self._epoch_fns[sig](train_state, buffer, env_states, act_key)
+        with get_watchdog().source(self.epoch_cost_name):
+            return self._epoch_fns[sig](
+                train_state, buffer, env_states, act_key
+            )
+
+    def epoch_jit(self, steps: int, update_every: int, warmup: bool = False):
+        """The cached jitted epoch program for a signature (None before
+        its first dispatch) — the cost registry lowers this with
+        abstract args (telemetry/costmodel.py)."""
+        return self._epoch_fns.get((steps, update_every, warmup))
 
 
 @struct.dataclass
@@ -494,6 +510,9 @@ class PopulationOnDeviceLoop:
 
         return jax.jit(epoch, donate_argnums=(0, 1))
 
+    # Watchdog/cost-registry source of the vmapped population epoch.
+    epoch_cost_name = "train/population_epoch"
+
     def epoch(
         self,
         state: TrainState,
@@ -508,10 +527,18 @@ class PopulationOnDeviceLoop:
         ``n_envs`` envs times ``n_members`` members, with a fused
         gradient burst per ``update_every`` window per member — one
         device dispatch for everything."""
+        from torch_actor_critic_tpu.diagnostics.watchdog import get_watchdog
+
         sig = (steps, update_every, warmup)
         if sig not in self._epoch_fns:
             self._epoch_fns[sig] = self._build_epoch(*sig)
-        return self._epoch_fns[sig](state, buffer, env_states, act_keys)
+        with get_watchdog().source(self.epoch_cost_name):
+            return self._epoch_fns[sig](state, buffer, env_states, act_keys)
+
+    def epoch_jit(self, steps: int, update_every: int, warmup: bool = False):
+        """The cached jitted population-epoch program (None before its
+        first dispatch) — the cost-registry lowering hook."""
+        return self._epoch_fns.get((steps, update_every, warmup))
 
     # ------------------------------------------------------------------- pbt
 
@@ -667,6 +694,62 @@ def _wrap_and_build(env_cls, config) -> t.Tuple[t.Any, SAC]:
     return env_cls, make_learner(config, actor, critic, env_cls.act_dim)
 
 
+def _abstract_args(*trees):
+    """Shape/dtype specs of the epoch-program arguments, captured
+    BEFORE dispatch (the program donates state+buffer) so the cost
+    registry can lower the compiled program without live buffers."""
+    try:
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), trees
+        )
+    except Exception:  # noqa: BLE001 — cost accounting must never
+        # break training
+        return ()
+
+
+def _note_epoch_cost(
+    loop, sig, abstract, cost_state, metrics, dt, telemetry, e
+):
+    """Fused-loop per-epoch cost attribution (telemetry on only):
+    register the epoch program's XLA cost analysis once, then add
+    ``cost/epoch_*`` metric columns and emit one ``cost`` telemetry
+    event for the dispatch that just drained. ``cost_state`` is the
+    mutable ``{"registered": bool, "peaks": Peaks|None}`` the driver
+    threads through its loop."""
+    from torch_actor_critic_tpu.telemetry.costmodel import (
+        Peaks,
+        get_cost_registry,
+        roofline,
+    )
+
+    registry = get_cost_registry()
+    if not cost_state["registered"]:
+        cost_state["registered"] = True
+        fn = loop.epoch_jit(*sig)
+        if fn is not None and abstract:
+            registry.register_jit(loop.epoch_cost_name, fn, *abstract)
+    cost = registry.get(loop.epoch_cost_name)
+    if cost is None:
+        return
+    if cost_state["peaks"] is None:
+        cost_state["peaks"] = Peaks.detect()
+    rl = roofline(cost, dt, calls=1, peaks=cost_state["peaks"])
+    metrics["cost/epoch_gflops"] = cost["flops"] / 1e9
+    metrics["cost/epoch_achieved_gflops_s"] = (
+        rl.get("achieved_flops_per_sec", 0.0) / 1e9
+    )
+    if "arithmetic_intensity" in rl:
+        metrics["cost/epoch_ai"] = rl["arithmetic_intensity"]
+    if "mfu" in rl:
+        metrics["cost/epoch_mfu"] = rl["mfu"]
+    if "bound" in rl:
+        metrics["cost/epoch_compute_bound"] = float(rl["bound"] == "compute")
+    telemetry.event(
+        "cost", epoch=int(e), programs={loop.epoch_cost_name: rl},
+        device_kind=cost_state["peaks"].device_kind,
+    )
+
+
 def warmup_steps(start_steps: int, update_every: int) -> int:
     """Policy-free warmup length per env: ``start_steps`` rounded down
     to an ``update_every`` multiple, at least one window (ref warmup
@@ -682,6 +765,7 @@ def train_on_device(
     tracker=None,
     checkpointer=None,
     seed: int = 0,
+    telemetry=None,
 ) -> dict:
     """Host driver for the fused loop: one device dispatch per epoch,
     host work = logging + checkpoints. The CLI routes here for
@@ -691,6 +775,10 @@ def train_on_device(
     the warmup phase covers ``start_steps`` policy-free steps (ref
     ``sac/algorithm.py:227-228``). Checkpoints persist learner + buffer
     state (env states re-reset on resume — episodes are seconds long).
+    ``telemetry`` (a TelemetryRecorder) has no host phases to span
+    here — the epoch IS one dispatch — but per-epoch ``cost`` events
+    (fused-program FLOPs/roofline, telemetry/costmodel.py) stream
+    through it and ``cost/epoch_*`` columns land in metrics.jsonl.
     """
     import numpy as np
 
@@ -728,7 +816,14 @@ def train_on_device(
     import time
 
     metrics: dict = {}
+    sig = (config.steps_per_epoch, config.update_every, False)
+    cost_state = {"registered": False, "peaks": None}
+    cost_abstract = None
     for e in range(start_epoch, start_epoch + config.epochs):
+        if telemetry is not None and cost_abstract is None:
+            cost_abstract = _abstract_args(
+                state, buffer, env_states, act_key
+            )
         t0 = time.time()
         state, buffer, env_states, act_key, m = loop.epoch(
             state,
@@ -754,6 +849,11 @@ def train_on_device(
             (config.steps_per_epoch // config.update_every)
             * config.updates_per_window / dt
         )
+        if telemetry is not None:
+            _note_epoch_cost(
+                loop, sig, cost_abstract, cost_state, metrics, dt,
+                telemetry, e,
+            )
         if tracker is not None and is_coordinator():
             tracker.log_metrics(metrics, e)
         # Final epoch always saves (same contract as the host Trainer):
@@ -767,6 +867,8 @@ def train_on_device(
             raise FloatingPointError(f"loss_q diverged at epoch {e}: {metrics}")
     if checkpointer is not None:
         checkpointer.wait()
+    if telemetry is not None:
+        telemetry.close()
     return metrics
 
 
@@ -885,7 +987,14 @@ def train_population_on_device(
 
     n_members = config.population
     metrics: dict = {}
+    sig = (config.steps_per_epoch, config.update_every, False)
+    cost_state = {"registered": False, "peaks": None}
+    cost_abstract = None
     for e in range(start_epoch, start_epoch + config.epochs):
+        if telemetry is not None and cost_abstract is None:
+            cost_abstract = _abstract_args(
+                state, buffer, env_states, act_keys
+            )
         t0 = time.time()
         state, buffer, env_states, act_keys, m = loop.epoch(
             state, buffer, env_states, act_keys,
@@ -911,6 +1020,14 @@ def train_population_on_device(
             (config.steps_per_epoch // config.update_every)
             * config.updates_per_window * n_members / dt
         )
+        if telemetry is not None:
+            # Whole-population program cost: the FLOPs already carry
+            # the member axis (one vmapped executable), so MFU here is
+            # the population's aggregate chip utilization.
+            _note_epoch_cost(
+                loop, sig, cost_abstract, cost_state, metrics, dt,
+                telemetry, e,
+            )
         if pbt_event is not None:
             ev = jax.device_get(pbt_event)
             exploited = np.flatnonzero(ev["exploited"])

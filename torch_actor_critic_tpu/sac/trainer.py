@@ -347,6 +347,15 @@ class Trainer:
                 )
             )
         self.telemetry = telemetry
+        # Compute-cost attribution (telemetry/costmodel.py): with
+        # telemetry on, the first update epoch registers the burst's
+        # XLA cost analysis (one extra lowering+compile, off the step
+        # path) and every later epoch reports achieved-FLOPs / roofline
+        # metrics against the burst+drain span time. telemetry=None
+        # leaves all of this untouched — no lowering, no extra keys.
+        self._burst_abstract = None
+        self._cost_registered = False
+        self._peaks = None  # costmodel.Peaks, detected lazily
         # Learning-health diagnostics (diagnostics/, docs/OBSERVABILITY
         # .md): with a tier on, per-burst in-graph metric rows are
         # collected (device arrays — no sync until the epoch drain),
@@ -618,6 +627,61 @@ class Trainer:
             done=stack_field(4).astype(np.float32),
         )
 
+    # ------------------------------------------------------ cost accounting
+
+    def _note_epoch_cost(self, rec, last_metrics, n_bursts, epoch):
+        """Per-epoch compute-cost attribution (telemetry on only):
+        register the burst program's XLA cost analysis on the first
+        update epoch, then report achieved-FLOPs / arithmetic
+        intensity / MFU / roofline class against the epoch's
+        burst+drain span time — `cost/` columns in metrics.jsonl and
+        one `cost` event per epoch in telemetry.jsonl."""
+        if n_bursts == 0:
+            return
+        from torch_actor_critic_tpu.telemetry.costmodel import (
+            Peaks,
+            get_cost_registry,
+            roofline,
+        )
+
+        registry = get_cost_registry()
+        name = self.dp.burst_cost_name
+        if not self._cost_registered:
+            # Once per run, off the step path. One extra lowering (and
+            # backend compile, for post-fusion byte honesty) of the
+            # already-built burst; failures degrade to "no cost keys".
+            self._cost_registered = True
+            fn = self.dp.burst_jit(self.config.updates_per_window)
+            if fn is not None and self._burst_abstract:
+                registry.register_jit(name, fn, *self._burst_abstract)
+        cost = registry.get(name)
+        if cost is None:
+            return
+        if self._peaks is None:
+            self._peaks = Peaks.detect()
+        burst_s = (
+            rec.timer.sums[_PH_BURST] + rec.timer.sums[_PH_DRAIN]
+        )
+        rl = roofline(cost, burst_s, calls=n_bursts, peaks=self._peaks)
+        last_metrics["cost/update_burst_gflops"] = cost["flops"] / 1e9
+        last_metrics["cost/update_burst_achieved_gflops_s"] = (
+            rl.get("achieved_flops_per_sec", 0.0) / 1e9
+        )
+        if "arithmetic_intensity" in rl:
+            last_metrics["cost/update_burst_ai"] = rl[
+                "arithmetic_intensity"
+            ]
+        if "mfu" in rl:
+            last_metrics["cost/update_burst_mfu"] = rl["mfu"]
+        if "bound" in rl:
+            last_metrics["cost/update_burst_compute_bound"] = float(
+                rl["bound"] == "compute"
+            )
+        rec.event(
+            "cost", epoch=int(epoch), programs={name: rl},
+            device_kind=self._peaks.device_kind,
+        )
+
     # --------------------------------------------------------- resilience
 
     def _epoch_seed(self, epoch: int, i: int) -> int:
@@ -879,6 +943,24 @@ class Trainer:
                     if rec is not None:
                         rec.lap(_PH_PLACE)
                     if step > cfg.update_after:
+                        if rec is not None and self._burst_abstract is None:
+                            # Shape/dtype specs of the burst arguments,
+                            # captured BEFORE dispatch (the burst
+                            # donates state+buffer) — the cost registry
+                            # lowers the compiled program with these at
+                            # epoch end (telemetry/costmodel.py).
+                            try:
+                                self._burst_abstract = (
+                                    jax.tree_util.tree_map(
+                                        lambda x: jax.ShapeDtypeStruct(
+                                            x.shape, x.dtype
+                                        ),
+                                        (self.state, self.buffer, chunk),
+                                    )
+                                )
+                            except Exception:  # noqa: BLE001 — cost
+                                # accounting must never break training
+                                self._burst_abstract = ()
                         # (config validation guarantees host_actor here)
                         if cfg.actor_param_lag and step + 1 >= cfg.start_steps:
                             # Mirror the PRE-burst params now (their
@@ -1074,6 +1156,13 @@ class Trainer:
                         rec.event("recompile_anomaly", epoch=e, **a)
             if rec is not None:
                 rec.lap(_PH_DRAIN)
+                # Per-program roofline for the epoch: burst FLOPs from
+                # the cost registry over the burst+drain span time just
+                # recorded (dispatch is async — queued device execution
+                # surfaces under drain). Adds cost/ columns to
+                # metrics.jsonl and a `cost` telemetry event; absent
+                # entirely with telemetry off.
+                self._note_epoch_cost(rec, last_metrics, len(losses_q), e)
             if self.population > 1:
                 # Per-member epoch-mean returns: the N learning curves.
                 for i in range(n):
@@ -1162,7 +1251,20 @@ class Trainer:
                 }
                 if self.watchdog is not None:
                     extra["xla_compiles"] = last_metrics.get("xla_compiles")
-                rec.epoch_end(e, extra=extra)
+                ev = rec.epoch_end(e, extra=extra)
+                attr = ev.get("attribution")
+                if attr is not None:
+                    # The rolling view accumulates in rec.summary();
+                    # the per-epoch line is the live signal ("the run
+                    # went input-bound at epoch 40" is actionable NOW).
+                    logger.info(
+                        "epoch %d attribution: %s (device %.0f%%, host "
+                        "%.0f%%, input %.0f%%)",
+                        e, attr["class"],
+                        100 * attr["device_busy_frac"],
+                        100 * attr["host_frac"],
+                        100 * attr["input_frac"],
+                    )
             # Recompilation-watchdog steady marking: the first update
             # epoch pays the burst compile, and its END pays the
             # sentinel/save/mirror compiles — so the regime is declared

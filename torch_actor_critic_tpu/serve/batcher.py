@@ -74,16 +74,23 @@ class ActResult(t.NamedTuple):
 class _Request:
     __slots__ = (
         "obs", "rows", "slot", "deterministic", "future", "t_enq",
-        "deadline",
+        "deadline", "request_id", "t_collect",
     )
 
-    def __init__(self, obs, rows, slot, deterministic, deadline_s=None):
+    def __init__(
+        self, obs, rows, slot, deterministic, deadline_s=None,
+        request_id=None,
+    ):
         self.obs = obs
         self.rows = rows
         self.slot = slot
         self.deterministic = deterministic
         self.future: Future = Future()
         self.t_enq = time.perf_counter()
+        # Correlation id for the per-request trace span and the shed/
+        # breaker log lines (the HTTP frontend's X-Request-Id).
+        self.request_id = request_id
+        self.t_collect: float | None = None
         # Absolute perf_counter deadline; None = the caller will wait
         # forever, so the request can never expire in the queue.
         self.deadline = (
@@ -113,6 +120,7 @@ class MicroBatcher:
         metrics: ServeMetrics | None = None,
         seed: int = 0,
         capacity: int = 1024,
+        span_log=None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -123,6 +131,12 @@ class MicroBatcher:
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.capacity = int(capacity)
         self.metrics = metrics if metrics is not None else ServeMetrics()
+        # Optional per-request span recording
+        # (telemetry.traceview.RequestSpanLog) for the cross-plane
+        # trace export: every instrumentation point below is a single
+        # `is not None` check when detached — the serving twin of the
+        # trainer's telemetry=None contract.
+        self.span_log = span_log
         self._key = jax.random.key(seed)
         self._queue: collections.deque[_Request] = collections.deque()
         self._lock = threading.Lock()
@@ -146,6 +160,7 @@ class MicroBatcher:
         deterministic: bool = True,
         slot: str = "default",
         deadline_s: float | None = None,
+        request_id: str | None = None,
     ) -> Future:
         """Enqueue one request; returns a Future resolving to
         :class:`ActResult`. ``obs`` is a single observation pytree or a
@@ -157,18 +172,24 @@ class MicroBatcher:
         at the measured service rate, and purged (future failed, never
         dispatched) if it expires while queued. Admission failures
         raise :class:`~torch_actor_critic_tpu.serve.admission.ShedError`
-        with a machine-readable reason."""
+        with a machine-readable reason. ``request_id`` threads through
+        the per-request trace span and shed records so a 429/503 can
+        be correlated with its timeline."""
         engine, _, _ = self.registry.acquire(slot)  # validates slot name
         breaker = self.registry.breaker(slot)
         if breaker is not None and not breaker.admits():
             # Fail fast while the slot's engine is tripped open: no
             # queue slot, no accelerator work, a concrete retry hint.
             self.metrics.record_shed("breaker_open")
+            self._note_shed(request_id, slot, "breaker_open")
             raise BreakerOpenError(
                 slot, breaker.retry_after_s(), breaker.state
             )
         obs, rows, batched = self._ensure_batched(engine, obs)
-        req = _Request(obs, rows, slot, bool(deterministic), deadline_s)
+        req = _Request(
+            obs, rows, slot, bool(deterministic), deadline_s,
+            request_id=request_id,
+        )
         outer: Future = Future()
 
         def _copy(f: Future):
@@ -192,6 +213,7 @@ class MicroBatcher:
                 )
             if len(self._queue) >= self.capacity:
                 self.metrics.record_shed("queue_full")
+                self._note_shed(request_id, slot, "queue_full")
                 raise ShedError(
                     "queue_full",
                     f"admission queue is at capacity "
@@ -208,6 +230,7 @@ class MicroBatcher:
                 ) * self._ema_row_s
                 if est_wait > deadline_s:
                     self.metrics.record_shed("deadline_infeasible")
+                    self._note_shed(request_id, slot, "deadline_infeasible")
                     raise ShedError(
                         "deadline_infeasible",
                         f"deadline of {deadline_s:.3f}s cannot be met: "
@@ -228,14 +251,28 @@ class MicroBatcher:
         deterministic: bool = True,
         slot: str = "default",
         timeout: float | None = 30.0,
+        request_id: str | None = None,
     ) -> ActResult:
         """Blocking :meth:`submit`. The timeout doubles as the request
         deadline: a caller that stops waiting leaves no orphan behind —
         its queued request is purged at group-collection time instead
         of burning a forward on an answer nobody reads."""
         return self.submit(
-            obs, deterministic, slot, deadline_s=timeout
+            obs, deterministic, slot, deadline_s=timeout,
+            request_id=request_id,
         ).result(timeout=timeout)
+
+    def _note_shed(self, request_id, slot, reason):
+        """One submit-time shed into the span log (when attached): the
+        rejection appears on the same timeline as the requests that
+        were served, under its correlation id."""
+        if self.span_log is None:
+            return
+        now = time.perf_counter()
+        self.span_log.record({
+            "request_id": request_id, "slot": slot, "rows": 0,
+            "t_enq": now, "t_done": now, "outcome": reason,
+        })
 
     def _est_backlog_wait_locked(self) -> float | None:
         """Estimated seconds to drain the current queue (None until the
@@ -300,6 +337,12 @@ class MicroBatcher:
         self._queue.extend(live)
         self.metrics.record_expired(len(expired))
         for r in expired:
+            if self.span_log is not None:
+                self.span_log.record({
+                    "request_id": r.request_id, "slot": r.slot,
+                    "rows": r.rows, "t_enq": r.t_enq, "t_done": now,
+                    "outcome": "expired",
+                })
             if not r.future.done():
                 r.future.set_exception(ShedError(
                     "expired",
@@ -364,6 +407,10 @@ class MicroBatcher:
                 rows += r.rows
                 if rows >= self.max_batch:
                     break
+            if self.span_log is not None:
+                t_collect = time.perf_counter()
+                for r in group:
+                    r.t_collect = t_collect
             return group
 
     def _next_key(self):
@@ -379,10 +426,18 @@ class MicroBatcher:
             err = BreakerOpenError(
                 slot_name, breaker.retry_after_s(), breaker.state
             )
+            now = time.perf_counter()
             for r in group:
                 if not r.future.done():
                     r.future.set_exception(err)
                 self.metrics.record_shed("breaker_open")
+                if self.span_log is not None:
+                    self.span_log.record({
+                        "request_id": r.request_id, "slot": r.slot,
+                        "rows": r.rows, "t_enq": r.t_enq,
+                        "t_collect": r.t_collect, "t_done": now,
+                        "outcome": "breaker_open",
+                    })
             return
         try:
             engine, params, generation = self.registry.acquire(slot_name)
@@ -402,23 +457,27 @@ class MicroBatcher:
             # larger than its top bucket would make bucket_for raise.
             chunk_rows = min(self.max_batch, engine.max_batch)
             outs = []
+            group_bucket = engine.bucket_for(min(chunk_rows, total))
             t_fwd = time.perf_counter()
             for lo in range(0, total, chunk_rows):
                 chunk = jax.tree_util.tree_map(
                     lambda x, lo=lo: x[lo:lo + chunk_rows], obs
                 )
                 n = min(chunk_rows, total - lo)
+                t_chunk = time.perf_counter()
                 outs.append(engine.act(
                     params, chunk,
                     None if det else self._next_key(),
                     deterministic=det,
                 ))
+                # The measured duration feeds the per-bucket roofline
+                # on /metrics `costs` (serve/metrics.cost_snapshot).
                 self.metrics.record_batch(
-                    rows=n, bucket=engine.bucket_for(n)
+                    rows=n, bucket=engine.bucket_for(n),
+                    dur_s=time.perf_counter() - t_chunk,
                 )
-            self._note_service_rate(
-                time.perf_counter() - t_fwd, total
-            )
+            t_fwd_end = time.perf_counter()
+            self._note_service_rate(t_fwd_end - t_fwd, total)
             action = outs[0] if len(outs) == 1 else np.concatenate(outs, 0)
             done_t = time.perf_counter()
             lo = 0
@@ -428,6 +487,15 @@ class MicroBatcher:
                 )
                 self.metrics.record_done((done_t - r.t_enq) * 1e3)
                 lo += r.rows
+                if self.span_log is not None:
+                    self.span_log.record({
+                        "request_id": r.request_id, "slot": r.slot,
+                        "rows": r.rows, "bucket": group_bucket,
+                        "generation": generation, "t_enq": r.t_enq,
+                        "t_collect": r.t_collect, "t_dispatch": t_fwd,
+                        "t_forward_end": t_fwd_end, "t_done": done_t,
+                        "outcome": "ok",
+                    })
             if breaker is not None:
                 breaker.record_success()
         except Exception as e:  # noqa: BLE001 — the dispatcher must
@@ -439,10 +507,18 @@ class MicroBatcher:
                 # and non-finite action outputs count toward the trip
                 # threshold; malformed requests / unknown slots do not.
                 breaker.record_failure(e)
+            now = time.perf_counter()
             for r in group:
                 if not r.future.done():
                     r.future.set_exception(e)
                 self.metrics.record_error()
+                if self.span_log is not None:
+                    self.span_log.record({
+                        "request_id": r.request_id, "slot": r.slot,
+                        "rows": r.rows, "t_enq": r.t_enq,
+                        "t_collect": r.t_collect, "t_done": now,
+                        "outcome": "error",
+                    })
 
     def _note_service_rate(self, dt_s: float, rows: int):
         """Fold one group's measured seconds-per-row into the EMA the

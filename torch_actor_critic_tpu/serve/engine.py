@@ -255,6 +255,10 @@ class PolicyEngine:
         :meth:`compile_stats` and are ``expected`` to the recompilation
         watchdog (a slot registered after the serving plane went steady
         must not flag its own warmup as anomalies)."""
+        from torch_actor_critic_tpu.telemetry.costmodel import (
+            get_cost_registry,
+        )
+
         warmed = []
         key = jax.random.key(0)
         self._warmup_active = True
@@ -266,6 +270,29 @@ class PolicyEngine:
                             (bucket,) + tuple(s.shape), s.dtype
                         ),
                         self.obs_spec,
+                    )
+                    # Per-bucket program cost -> the registry, BEFORE
+                    # the act() below (donation may consume zero_obs on
+                    # accelerators). compiled=False: one cheap re-trace
+                    # per bucket at warmup, no extra backend compile —
+                    # FLOPs are exact, bytes pre-fusion (an upper
+                    # bound; docs/OBSERVABILITY.md "Cost attribution").
+                    get_cost_registry().register_jit(
+                        self._trace_names[bucket],
+                        self._fwd[True],
+                        jax.tree_util.tree_map(
+                            lambda x: jax.ShapeDtypeStruct(
+                                np.shape(x), x.dtype
+                            ),
+                            params,
+                        ),
+                        jax.tree_util.tree_map(
+                            lambda x: jax.ShapeDtypeStruct(
+                                x.shape, x.dtype
+                            ),
+                            zero_obs,
+                        ),
+                        compiled=False,
                     )
                     for det in (True,) if deterministic_only else (True, False):
                         if det:

@@ -45,6 +45,13 @@ class ServeMetrics:
         self._responses_at_snapshot = 0
         self._snapshots_taken = 0
         self._latency = FixedBucketHistogram()
+        # Per-bucket forward-time accounting (cost attribution): the
+        # dispatcher reports each engine call's measured duration so
+        # /metrics can combine it with the bucket program's registered
+        # FLOPs/bytes into a live roofline (docs/OBSERVABILITY.md
+        # "Cost attribution & roofline").
+        self._bucket_time: t.Dict[int, t.Dict[str, float]] = {}
+        self._peaks = None  # costmodel.Peaks, detected lazily
 
     # ----------------------------------------------------------- recording
 
@@ -53,11 +60,18 @@ class ServeMetrics:
             self.requests_total += 1
             self.queue_depth = depth
 
-    def record_batch(self, rows: int, bucket: int):
+    def record_batch(self, rows: int, bucket: int, dur_s: float = 0.0):
         with self._lock:
             self.batches_total += 1
             self.rows_total += rows
             self.padded_rows_total += bucket
+            if dur_s > 0.0:
+                agg = self._bucket_time.setdefault(
+                    bucket, {"calls": 0, "rows": 0, "total_s": 0.0}
+                )
+                agg["calls"] += 1
+                agg["rows"] += rows
+                agg["total_s"] += dur_s
 
     def record_done(self, latency_ms: float):
         with self._lock:
@@ -88,6 +102,39 @@ class ServeMetrics:
             )
 
     # ------------------------------------------------------------ snapshot
+
+    def cost_snapshot(self) -> t.Dict[str, t.Any]:
+        """Per-bucket live roofline for ``/metrics`` ``costs``: each
+        bucket's registered program cost (``serve/forward[bN]``,
+        populated at engine warmup) against its measured cumulative
+        forward time — achieved FLOP/s, arithmetic intensity, MFU and
+        compute-/memory-bound classification when peaks are known.
+        Buckets with no registered cost or no traffic are omitted."""
+        from torch_actor_critic_tpu.telemetry.costmodel import (
+            Peaks,
+            get_cost_registry,
+            roofline,
+        )
+
+        with self._lock:
+            buckets = {
+                b: dict(agg) for b, agg in self._bucket_time.items()
+            }
+            if self._peaks is None:
+                self._peaks = Peaks.detect()
+            peaks = self._peaks
+        registry = get_cost_registry()
+        out: t.Dict[str, t.Any] = {}
+        for b, agg in sorted(buckets.items()):
+            cost = registry.get(f"serve/forward[b{b}]")
+            if cost is None or agg["total_s"] <= 0.0:
+                continue
+            entry = roofline(
+                cost, agg["total_s"], calls=int(agg["calls"]), peaks=peaks
+            )
+            entry["rows"] = int(agg["rows"])
+            out[f"b{b}"] = entry
+        return out
 
     def snapshot(self) -> t.Dict[str, t.Any]:
         """Point-in-time metrics dict (the ``/metrics`` payload and the

@@ -38,6 +38,7 @@ import math
 import signal
 import threading
 import typing as t
+import uuid
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -73,13 +74,19 @@ class PolicyClient:
         deterministic: bool = True,
         slot: str = "default",
         timeout: float | None = 30.0,
+        request_id: str | None = None,
     ) -> ActResult:
-        return self.batcher.act(obs, deterministic, slot, timeout=timeout)
+        return self.batcher.act(
+            obs, deterministic, slot, timeout=timeout, request_id=request_id
+        )
 
     def act_async(
-        self, obs: t.Any, deterministic: bool = True, slot: str = "default"
+        self, obs: t.Any, deterministic: bool = True, slot: str = "default",
+        request_id: str | None = None,
     ):
-        return self.batcher.submit(obs, deterministic, slot)
+        return self.batcher.submit(
+            obs, deterministic, slot, request_id=request_id
+        )
 
 
 def _parse_obs(raw, obs_spec):
@@ -123,8 +130,13 @@ class PolicyServer:
         act_timeout_s: float = 30.0,
         extra_snapshot: t.Callable[[], dict] | None = None,
         capacity: int = 1024,
+        span_log=None,
     ):
         self.registry = registry
+        # Per-request trace spans (telemetry.traceview.RequestSpanLog):
+        # attached by --trace-export; None costs one pointer check per
+        # request in the batcher.
+        self.span_log = span_log
         # Co-located processes (a trainer serving its own policy, a
         # custom health exporter) merge their own snapshot into
         # /metrics — e.g. a telemetry recorder's training phases under
@@ -142,6 +154,7 @@ class PolicyServer:
         self.batcher = MicroBatcher(
             registry, max_batch=max_batch, max_wait_ms=max_wait_ms,
             metrics=self.metrics, seed=seed, capacity=capacity,
+            span_log=span_log,
         )
         self.client = PolicyClient(registry, self.batcher)
         # Graceful-drain state (docs/SERVING.md "Overload &
@@ -211,6 +224,10 @@ class PolicyServer:
                     snap["queue_capacity"] = server.batcher.capacity
                     snap["draining"] = server._draining
                     snap["breakers"] = server.registry.breaker_stats()
+                    # Per-bucket live roofline: registered program
+                    # FLOPs/bytes over measured forward time
+                    # (docs/OBSERVABILITY.md "Cost attribution").
+                    snap["costs"] = server.metrics.cost_snapshot()
                     if server.extra_snapshot is not None:
                         try:
                             snap.update(server.extra_snapshot())
@@ -239,25 +256,36 @@ class PolicyServer:
                     self._send(404, {"error": f"no route {self.path}"})
 
             def _act(self, body: dict):
+                # Correlation id: client-supplied X-Request-Id or a
+                # generated one; echoed on EVERY response (incl. 429/
+                # 503) and threaded through the shed/breaker log lines
+                # and the per-request trace spans, so a rejection can
+                # be matched to its timeline.
+                rid = self.headers.get("X-Request-Id") or uuid.uuid4().hex[:16]
+                rid_hdr = {"X-Request-Id": rid}
                 if server._draining:
+                    logger.warning(
+                        "shed request_id=%s reason=draining", rid
+                    )
                     self._send(
                         503,
                         {
                             "error": "server is draining; not accepting "
                                      "new requests",
                             "reason": "draining",
+                            "request_id": rid,
                         },
-                        headers={"Retry-After": "1"},
+                        headers={"Retry-After": "1", **rid_hdr},
                     )
                     return
                 slot = body.get("model", "default")
                 try:
                     engine, _, _ = server.registry.acquire(slot)
                 except KeyError as e:
-                    self._send(404, {"error": str(e)})
+                    self._send(404, {"error": str(e)}, headers=rid_hdr)
                     return
                 if "obs" not in body:
-                    self._send(400, {"error": 'missing "obs"'})
+                    self._send(400, {"error": 'missing "obs"'}, headers=rid_hdr)
                     return
                 try:
                     obs = _parse_obs(body["obs"], engine.obs_spec)
@@ -266,6 +294,7 @@ class PolicyServer:
                         deterministic=bool(body.get("deterministic", True)),
                         slot=slot,
                         timeout=server.act_timeout_s,
+                        request_id=rid,
                     )
                 except ShedError as e:
                     # Admission control / breaker / drain: submit-time
@@ -277,9 +306,13 @@ class PolicyServer:
                     # Retry-After from the shed's own estimate.
                     code = 429 if e.reason in SUBMIT_SHED_REASONS else 503
                     retry_after = max(1, math.ceil(e.retry_after_s))
+                    logger.warning(
+                        "shed request_id=%s slot=%s reason=%s -> %d",
+                        rid, slot, e.reason, code,
+                    )
                     self._send(
-                        code, e.to_payload(),
-                        headers={"Retry-After": str(retry_after)},
+                        code, dict(e.to_payload(), request_id=rid),
+                        headers={"Retry-After": str(retry_after), **rid_hdr},
                     )
                     return
                 except FutureTimeoutError:
@@ -287,27 +320,35 @@ class PolicyServer:
                     # bug: 503 + Retry-After tells well-behaved clients
                     # (and load balancers) to back off and retry, where
                     # a generic 500 reads as "broken, page someone".
+                    logger.warning(
+                        "timeout request_id=%s slot=%s after %.1fs",
+                        rid, slot, server.act_timeout_s,
+                    )
                     self._send(
                         503,
                         {
                             "error": "policy backend timed out; retry",
                             "timeout_s": server.act_timeout_s,
+                            "request_id": rid,
                         },
-                        headers={"Retry-After": "1"},
+                        headers={"Retry-After": "1", **rid_hdr},
                     )
                     return
                 except (ValueError, TypeError) as e:
-                    self._send(400, {"error": str(e)})
+                    self._send(400, {"error": str(e)}, headers=rid_hdr)
                     return
                 except Exception as e:  # noqa: BLE001 — engine failure
-                    logger.exception("act failed")
-                    self._send(500, {"error": repr(e)[:500]})
+                    logger.exception("act failed (request_id=%s)", rid)
+                    self._send(
+                        500, {"error": repr(e)[:500], "request_id": rid},
+                        headers=rid_hdr,
+                    )
                     return
                 self._send(200, {
                     "action": np.asarray(res.action).tolist(),
                     "generation": res.generation,
                     "model": slot,
-                })
+                }, headers=rid_hdr)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True

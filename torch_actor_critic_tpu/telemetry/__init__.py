@@ -19,8 +19,21 @@ host<->TPU boundary:
   annotations and the ``--profile-epochs A:B`` capture window.
 - :mod:`sinks` — JSONL event stream under the Tracker run dir, a human
   ``summary()`` table, and the ``/metrics``-style snapshot schema.
+- :mod:`costmodel` — per-program XLA cost registry (FLOPs/bytes keyed
+  by the watchdog's source names), live roofline/MFU accounting, and
+  host/device/input epoch attribution.
+- :mod:`traceview` — cross-plane Perfetto (``chrome://tracing``)
+  export merging training phase spans, serving per-request spans and
+  XLA compile events onto one timeline (``--trace-export``).
 """
 
+from torch_actor_critic_tpu.telemetry.costmodel import (
+    CostRegistry,
+    Peaks,
+    classify_epoch,
+    get_cost_registry,
+    roofline,
+)
 from torch_actor_critic_tpu.telemetry.histogram import FixedBucketHistogram
 from torch_actor_critic_tpu.telemetry.memory import device_memory_watermarks
 from torch_actor_critic_tpu.telemetry.profiler import (
@@ -38,17 +51,28 @@ from torch_actor_critic_tpu.telemetry.sinks import (
     format_summary,
     json_sanitize,
 )
+from torch_actor_critic_tpu.telemetry.traceview import (
+    RequestSpanLog,
+    export_trace,
+)
 
 __all__ = [
     "PHASES",
+    "CostRegistry",
     "FixedBucketHistogram",
     "JsonlSink",
+    "Peaks",
     "PhaseTimer",
     "ProfilerWindow",
+    "RequestSpanLog",
     "SpanRing",
     "TelemetryRecorder",
+    "classify_epoch",
     "device_memory_watermarks",
+    "export_trace",
     "format_summary",
+    "get_cost_registry",
     "json_sanitize",
     "parse_profile_epochs",
+    "roofline",
 ]

@@ -34,6 +34,10 @@ import typing as t
 
 import numpy as np
 
+from torch_actor_critic_tpu.telemetry.costmodel import (
+    PHASE_PLANES,
+    classify_epoch,
+)
 from torch_actor_critic_tpu.telemetry.memory import device_memory_watermarks
 from torch_actor_critic_tpu.telemetry.profiler import ProfilerWindow
 from torch_actor_critic_tpu.telemetry.sinks import JsonlSink, format_summary
@@ -181,6 +185,12 @@ class TelemetryRecorder:
         self._run_maxs = [0.0] * len(self.phases)
         self._t_epoch: float | None = None
         self.last_memory: dict | None = None
+        # Host/device/input epoch attribution (costmodel.classify_epoch)
+        # — rolling counts per class plus frac sums, surfaced by
+        # summary() and carried on every epoch event.
+        self.last_attribution: dict | None = None
+        self._attr_counts: t.Dict[str, int] = {}
+        self._attr_frac_sums = {"device": 0.0, "host": 0.0, "input": 0.0}
 
         self.sink = (
             JsonlSink(str(run_dir) + "/telemetry.jsonl")
@@ -278,6 +288,19 @@ class TelemetryRecorder:
                 for k, v in phases.items()
             },
         }
+        # Host/device/input attribution rides the epoch event whenever
+        # the phase taxonomy is the Trainer's (custom phase sets skip
+        # it rather than misclassify).
+        if wall_s > 0 and any(p in PHASE_PLANES for p in phases):
+            attr = classify_epoch(phases, wall_s)
+            event["attribution"] = attr
+            self.last_attribution = attr
+            self._attr_counts[attr["class"]] = (
+                self._attr_counts.get(attr["class"], 0) + 1
+            )
+            self._attr_frac_sums["device"] += attr["device_busy_frac"]
+            self._attr_frac_sums["host"] += attr["host_frac"]
+            self._attr_frac_sums["input"] += attr["input_frac"]
         if extra:
             event.update({k: v for k, v in extra.items()})
         if self.counters:
@@ -332,9 +355,39 @@ class TelemetryRecorder:
             out["events_written"] = self.sink.events_written
         return out
 
+    def attribution_summary(self) -> dict | None:
+        """Rolling host/device/input attribution over the recorded
+        epochs: per-class epoch counts and mean plane fractions, or
+        None before the first attributed epoch."""
+        n = sum(self._attr_counts.values())
+        if not n:
+            return None
+        return {
+            "epochs": n,
+            "by_class": dict(self._attr_counts),
+            "mean_device_busy_frac": round(
+                self._attr_frac_sums["device"] / n, 4
+            ),
+            "mean_host_frac": round(self._attr_frac_sums["host"] / n, 4),
+            "mean_input_frac": round(self._attr_frac_sums["input"] / n, 4),
+        }
+
     def summary(self) -> str:
-        """Human phase-breakdown table over the whole run."""
-        return format_summary(self.run_stats(), self.counters)
+        """Human phase-breakdown table over the whole run, plus the
+        rolling host/device/input attribution when recorded."""
+        out = format_summary(self.run_stats(), self.counters)
+        attr = self.attribution_summary()
+        if attr is not None:
+            classes = ", ".join(
+                f"{k} x{v}" for k, v in sorted(attr["by_class"].items())
+            )
+            out += (
+                f"\nepoch attribution: {classes} | mean fracs: device "
+                f"{attr['mean_device_busy_frac']:.0%}, host "
+                f"{attr['mean_host_frac']:.0%}, input "
+                f"{attr['mean_input_frac']:.0%}"
+            )
+        return out
 
     def close(self) -> None:
         self.profiler.close()

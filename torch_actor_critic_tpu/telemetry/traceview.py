@@ -1,0 +1,259 @@
+"""Cross-plane Perfetto (chrome://tracing) trace export.
+
+The phase aggregates answer "where did the epoch go"; this module
+answers "show me" — one ``trace_event``-format JSON timeline merging:
+
+- **training phase spans** from the recorder's :class:`SpanRing`
+  (every individual ``act``/``env_step``/``burst_dispatch``/... lap,
+  not the per-epoch sums);
+- **serving per-request spans** from a :class:`RequestSpanLog` the
+  micro-batcher fills when one is attached: queue → collect →
+  forward → respond per request, under its ``X-Request-Id``, so a
+  slow (or shed) response can be correlated with exactly what the
+  dispatcher and engine were doing;
+- **XLA compile events** from the recompilation watchdog's bounded
+  ring — a compile stall sits ON the same timeline as the request
+  that paid it.
+
+Load the output at ``chrome://tracing`` or https://ui.perfetto.dev.
+``--trace-export PATH`` on train.py / serve.py writes it at exit;
+``make cost-smoke`` asserts both planes land in one file.
+
+Timestamps: span sources use ``time.perf_counter`` (monotonic), the
+watchdog uses ``time.time``; both are mapped onto the wall clock via
+one process-wide anchor captured at first use, so all planes of one
+process share a timeline. Merging traces from *different* processes
+is subject to their wall-clock skew — fine for eyeballs, not for
+sub-millisecond cross-process ordering.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+import typing as t
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "RequestSpanLog",
+    "compile_events",
+    "export_trace",
+    "serve_request_events",
+    "span_event",
+    "training_events",
+]
+
+# trace_event pids: one fake "process" lane per plane.
+TRAIN_PID = 1
+SERVE_PID = 2
+XLA_PID = 3
+
+_ANCHOR: t.Tuple[float, float] | None = None
+_ANCHOR_LOCK = threading.Lock()
+
+
+def _anchor() -> t.Tuple[float, float]:
+    """(wall_time, perf_counter) captured once per process — the
+    affine map between the monotonic span clocks and the wall clock."""
+    global _ANCHOR
+    with _ANCHOR_LOCK:
+        if _ANCHOR is None:
+            _ANCHOR = (time.time(), time.perf_counter())
+        return _ANCHOR
+
+
+def perf_to_us(t_perf: float) -> float:
+    """Monotonic (perf_counter) seconds -> wall-clock microseconds."""
+    wall0, perf0 = _anchor()
+    return (wall0 + (t_perf - perf0)) * 1e6
+
+
+def span_event(
+    name: str,
+    ts_us: float,
+    dur_us: float,
+    pid: int,
+    tid: int,
+    args: dict | None = None,
+) -> t.List[dict]:
+    """One span as a paired B/E event couple (Perfetto renders pairs
+    and complete events identically; pairs survive naive line-oriented
+    tooling better and are what tests pin). Zero-length spans get a
+    0.5us floor so the E never sorts ahead of its own B (export_trace
+    orders E-before-B at equal timestamps)."""
+    begin = {"name": name, "ph": "B", "ts": ts_us, "pid": pid, "tid": tid}
+    if args:
+        begin["args"] = args
+    end = {
+        "name": name, "ph": "E", "ts": ts_us + max(dur_us, 0.5),
+        "pid": pid, "tid": tid,
+    }
+    return [begin, end]
+
+
+def training_events(recorder) -> t.List[dict]:
+    """The recorder's span ring as trace events: every retained
+    individual phase lap, labeled with its phase name, on the train
+    pid (one tid — the host loop is single-threaded)."""
+    events: t.List[dict] = []
+    phases = recorder.phases
+    for phase, t0, dur in recorder.ring.spans():
+        name = phases[phase] if 0 <= phase < len(phases) else f"phase{phase}"
+        events.extend(span_event(
+            name, perf_to_us(t0), dur * 1e6, TRAIN_PID, 0
+        ))
+    return events
+
+
+class RequestSpanLog:
+    """Bounded per-request span recording for the serving plane.
+
+    The batcher stamps each request's lifecycle (submit → collect →
+    forward → done, or a shed/expiry outcome) into one dict per
+    request; memory is bounded (``capacity`` newest records survive).
+    Recording is a deque append under a lock — the serving hot path
+    pays it only when a log is attached (``--trace-export``); with
+    none attached the batcher's pointer check is the whole cost,
+    the same contract as ``telemetry=None``."""
+
+    def __init__(self, capacity: int = 2048):
+        self._records: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, rec: dict) -> None:
+        with self._lock:
+            self._records.append(rec)
+
+    def records(self) -> t.List[dict]:
+        with self._lock:
+            return list(self._records)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+
+# Per-request stage boundaries -> child span (name, start key, end key).
+_REQUEST_STAGES = (
+    ("queue", "t_enq", "t_collect"),
+    ("collect", "t_collect", "t_dispatch"),
+    ("forward", "t_dispatch", "t_forward_end"),
+    ("respond", "t_forward_end", "t_done"),
+)
+
+
+def serve_request_events(records: t.Iterable[dict]) -> t.List[dict]:
+    """Request-span records -> trace events: one enclosing ``request``
+    span per record plus its stage children, on a per-request tid so
+    concurrent requests render as parallel lanes. Shed/expired
+    requests (no dispatch timestamps) still produce their enclosing
+    span with the outcome in ``args`` — the 429/503 IS on the
+    timeline."""
+    events: t.List[dict] = []
+    for i, rec in enumerate(records):
+        t0 = rec.get("t_enq")
+        t_end = rec.get("t_done")
+        if t0 is None:
+            continue
+        if t_end is None:
+            # Shed before completion: close the span at the last known
+            # timestamp so the trace stays well-formed.
+            t_end = max(
+                (rec[k] for _, _, k in _REQUEST_STAGES if rec.get(k)),
+                default=t0,
+            )
+        tid = i % 64  # bounded lanes; B/E pairs on one lane may nest
+        args = {
+            k: rec[k]
+            for k in ("request_id", "slot", "rows", "bucket", "outcome",
+                      "generation")
+            if rec.get(k) is not None
+        }
+        # The enclosing span opens 1us early and closes 1us late so its
+        # children nest STRICTLY inside it — shared boundary timestamps
+        # would otherwise interleave the B/E pairs under the export's
+        # E-before-B tie ordering.
+        events.extend(span_event(
+            "request", perf_to_us(t0) - 1.0, (t_end - t0) * 1e6 + 2.0,
+            SERVE_PID, tid, args=args,
+        ))
+        for name, k0, k1 in _REQUEST_STAGES:
+            s0, s1 = rec.get(k0), rec.get(k1)
+            if s0 is None or s1 is None:
+                continue
+            events.extend(span_event(
+                name, perf_to_us(s0), (s1 - s0) * 1e6, SERVE_PID, tid,
+            ))
+    return events
+
+
+def compile_events(records: t.Iterable[dict]) -> t.List[dict]:
+    """Watchdog compile records (``{source, time, duration_s}``, wall
+    clock) -> trace events on the XLA pid. The monitoring event fires
+    when the compile FINISHES, so the span runs [time - duration,
+    time]."""
+    events: t.List[dict] = []
+    for rec in records:
+        end_wall = float(rec.get("time", 0.0))
+        dur = float(rec.get("duration_s", 0.0))
+        if end_wall <= 0:
+            continue
+        events.extend(span_event(
+            f"compile {rec.get('source', 'unattributed')}",
+            (end_wall - dur) * 1e6, dur * 1e6, XLA_PID, 0,
+        ))
+    return events
+
+
+def _metadata_events() -> t.List[dict]:
+    out = []
+    for pid, name in (
+        (TRAIN_PID, "train"), (SERVE_PID, "serve"), (XLA_PID, "xla-compile"),
+    ):
+        out.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name},
+        })
+    return out
+
+
+def export_trace(path: str | os.PathLike, *event_lists: t.List[dict]) -> dict:
+    """Merge event lists, sort by timestamp (E-before-B at equal ts so
+    zero-length neighbors never interleave as crossed pairs), and
+    write one Perfetto-loadable JSON object. Returns a small summary
+    (counts per pid) for logging/smoke assertions."""
+    events: t.List[dict] = []
+    for lst in event_lists:
+        events.extend(lst)
+    spans = [e for e in events if e.get("ph") in ("B", "E")]
+    spans.sort(key=lambda e: (e["ts"], 0 if e["ph"] == "E" else 1))
+    merged = _metadata_events() + spans
+    payload = {"traceEvents": merged, "displayTimeUnit": "ms"}
+    path = str(path)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    by_pid: t.Dict[int, int] = {}
+    for e in spans:
+        if e["ph"] == "B":
+            by_pid[e["pid"]] = by_pid.get(e["pid"], 0) + 1
+    summary = {
+        "path": path,
+        "spans_total": sum(by_pid.values()),
+        "train_spans": by_pid.get(TRAIN_PID, 0),
+        "serve_spans": by_pid.get(SERVE_PID, 0),
+        "compile_spans": by_pid.get(XLA_PID, 0),
+    }
+    logger.info(
+        "trace exported: %s (%d train / %d serve / %d compile spans)",
+        path, summary["train_spans"], summary["serve_spans"],
+        summary["compile_spans"],
+    )
+    return summary

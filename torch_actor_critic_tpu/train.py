@@ -81,6 +81,15 @@ def parse_arguments(argv=None) -> argparse.Namespace:
         "a couple of post-warmup epochs — the workflow "
         "docs/OBSERVABILITY.md describes.",
     )
+    parser.add_argument(
+        "--trace-export",
+        metavar="PATH",
+        default=None,
+        help="Write a cross-plane Perfetto (chrome://tracing) trace to "
+        "PATH at exit: every recorded training phase span plus XLA "
+        "compile events on one timeline (docs/OBSERVABILITY.md 'Cost "
+        "attribution & roofline'); implies --telemetry true.",
+    )
     parser.add_argument("--runs-root", default="runs", help="Tracking root directory")
     parser.add_argument(
         "--no-save-buffer",
@@ -172,11 +181,40 @@ def main(argv=None):
 
     profile_window = parse_profile_epochs(args.profile_epochs)
     telemetry_rec = None
-    if config.telemetry or profile_window:
+    if config.telemetry or profile_window or args.trace_export:
         telemetry_rec = TelemetryRecorder(
             run_dir=tracker.run_dir if tracker.enabled else None,
             profile_epochs=profile_window,
         )
+
+    def export_trace_if_requested():
+        # Cross-plane Perfetto export (--trace-export): training phase
+        # spans from the recorder ring + every watchdog-attributed XLA
+        # compile, one timeline (telemetry/traceview.py).
+        if args.trace_export is None or not is_coordinator():
+            return
+        from torch_actor_critic_tpu.diagnostics.watchdog import get_watchdog
+        from torch_actor_critic_tpu.telemetry.traceview import (
+            compile_events,
+            export_trace,
+            training_events,
+        )
+
+        spans = (
+            [training_events(telemetry_rec)]
+            if telemetry_rec is not None else []
+        )
+        summary = export_trace(
+            args.trace_export, *spans,
+            compile_events(get_watchdog().compile_log()),
+        )
+        logger.info(
+            "trace exported to %s (%d train spans, %d compile spans) — "
+            "load at chrome://tracing or https://ui.perfetto.dev",
+            summary["path"], summary["train_spans"],
+            summary["compile_spans"],
+        )
+
     if config.on_device:
         if config.diagnostics != "off":
             logger.warning(
@@ -204,14 +242,15 @@ def main(argv=None):
                 mesh=mesh, tracker=tracker, checkpointer=checkpointer,
                 seed=args.seed, telemetry=telemetry_rec,
             )
+            export_trace_if_requested()
             logger.info("final metrics: %s", metrics)
             return metrics
-        if telemetry_rec is not None:
+        if profile_window:
             logger.warning(
-                "telemetry/--profile-epochs are host-Trainer features; "
-                "the fused on-device loop (--on-device true) has no "
-                "host-visible phases to span — use --profile for a "
-                "whole-run trace instead"
+                "--profile-epochs is a host-Trainer feature; the fused "
+                "on-device loop has no host-visible phases to window — "
+                "use --profile for a whole-run trace instead (per-epoch "
+                "`cost` events still stream with --telemetry true)"
             )
         from torch_actor_critic_tpu.sac.ondevice import train_on_device
 
@@ -222,8 +261,9 @@ def main(argv=None):
         metrics = train_on_device(
             env_name, config,
             mesh=mesh, tracker=tracker, checkpointer=checkpointer,
-            seed=args.seed,
+            seed=args.seed, telemetry=telemetry_rec,
         )
+        export_trace_if_requested()
         logger.info("final metrics: %s", metrics)
         return metrics
     # Preemption guard (resilience/, docs/RESILIENCE.md): one SIGTERM/
@@ -267,6 +307,7 @@ def main(argv=None):
         )
         raise SystemExit(p.exit_code)
     finally:
+        export_trace_if_requested()
         trainer.close()
         if guard is not None:
             guard.uninstall()
