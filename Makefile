@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke
+.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -76,6 +76,15 @@ diag-smoke:
 # of the population checkpoint (docs/SCALING.md "population").
 pop-smoke:
 	JAX_PLATFORMS=cpu python scripts/pop_smoke.py
+
+# Named-mesh GSPMD smoke: forced 4-device CPU run exercising the dp
+# burst (jit-with-sharding, replica canary 0.0), the dp+fsdp hybrid
+# (no version gate) and --population 8 member-sharded fused training
+# end-to-end through the CLI, incl. a sharded-checkpoint resume
+# (docs/SCALING.md "The mesh"). The script forces the device count
+# itself before importing jax.
+mesh-smoke:
+	python scripts/mesh_smoke.py
 
 # Compute-cost attribution smoke: short CPU train with telemetry + an
 # in-process serve round -> every per-epoch `cost` event present and

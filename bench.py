@@ -562,6 +562,155 @@ def bench_population_fused(budget_s=420.0):
     return out
 
 
+def bench_sharding(budget_s=420.0):
+    """Named-mesh GSPMD scaling (PR 8): the jit-with-sharding dp burst
+    at the headline config across mesh shapes dp x fsdp in {1x1, 2x1,
+    2x2}, reporting lockstep grad-steps/s, aggregate row throughput
+    and estimated PER-DEVICE MFU (each dp shard computes one
+    batch-64 gradient per step; fsdp changes layout, not FLOPs), plus
+    the population_fused point re-run with the member axis sharded
+    P('dp') over every visible device — the two scale-out paths the
+    legacy shard_map substrate blocked. On a single-device backend the
+    multi-device points record a skip reason (CPU tier-1 proves them
+    under the forced-device-count shim; TPU numbers are the artifact).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torch_actor_critic_tpu.core.types import Batch
+    from torch_actor_critic_tpu.models import Actor, DoubleCritic
+    from torch_actor_critic_tpu.parallel import (
+        DataParallelSAC,
+        init_sharded_buffer,
+        make_mesh,
+        shard_chunk,
+    )
+    from torch_actor_critic_tpu.sac import SAC
+    from torch_actor_critic_tpu.utils.config import SACConfig
+    from torch_actor_critic_tpu.utils.sync import drain
+
+    n_avail = jax.device_count()
+    flops = sac_flops_per_step()
+    try:
+        peak = peak_flops_for(jax.devices()[0].device_kind)
+    except Exception:  # noqa: BLE001
+        peak = None
+
+    def chunk_for(n_dev, per_dev=32):
+        ks = jax.random.split(jax.random.key(1), 5)
+        shape = (n_dev, per_dev)
+        return Batch(
+            states=jax.random.normal(ks[0], shape + (OBS_DIM,)),
+            actions=jnp.tanh(jax.random.normal(ks[1], shape + (ACT_DIM,))),
+            rewards=jax.random.normal(ks[2], shape),
+            next_states=jax.random.normal(ks[3], shape + (OBS_DIM,)),
+            done=jnp.zeros(shape),
+        )
+
+    out = {"device_count": n_avail, "burst": [], }
+    t_start = time.time()
+    for dp, fsdp in ((1, 1), (2, 1), (2, 2)):
+        entry = {"mesh": f"dp{dp}xfsdp{fsdp}"}
+        out["burst"].append(entry)
+        if dp * fsdp > n_avail:
+            entry["skipped"] = f"needs {dp * fsdp} devices, have {n_avail}"
+            continue
+        if time.time() - t_start > budget_s:
+            entry["skipped"] = "budget exhausted"
+            continue
+        try:
+            cfg = SACConfig(hidden_sizes=HIDDEN, batch_size=BATCH)
+            sac = SAC(
+                cfg,
+                Actor(act_dim=ACT_DIM, hidden_sizes=HIDDEN),
+                DoubleCritic(hidden_sizes=HIDDEN),
+                ACT_DIM,
+            )
+            learner = DataParallelSAC(sac, make_mesh(dp=dp, fsdp=fsdp))
+            state = learner.init_state(
+                jax.random.key(0), jnp.zeros((OBS_DIM,))
+            )
+            buf = init_sharded_buffer(
+                100_000, jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32),
+                ACT_DIM, learner.mesh,
+            )
+            chunk = shard_chunk(chunk_for(dp), learner.mesh)
+            # compile + warm, then time a fresh dispatch
+            state, buf, m = learner.update_burst(state, buf, chunk, BURST)
+            drain(m["loss_q"])
+            t0 = time.perf_counter()
+            state, buf, m = learner.update_burst(state, buf, chunk, BURST)
+            drain(m["loss_q"])
+            dt = time.perf_counter() - t0
+            sps = BURST / dt
+            entry["grad_steps_per_sec"] = round(sps, 1)
+            # Every dp shard grinds one batch-64 gradient per lockstep
+            # step: aggregate row throughput scales with dp.
+            entry["rows_per_sec"] = round(sps * BATCH * dp, 1)
+            if peak:
+                entry["est_mfu_per_device"] = round(sps * flops / peak, 5)
+        except Exception as e:  # noqa: BLE001 — per-point best effort
+            entry["error"] = repr(e)[:200]
+        log(f"sharding {entry}")
+
+    # population_fused with the member axis sharded over dp (the PR 6
+    # loop was pinned to one device; this is the unlock).
+    pop = {"members": 8, "mesh_dp": n_avail}
+    out["population_member_sharded"] = pop
+    try:
+        from torch_actor_critic_tpu.envs.ondevice import PendulumJax
+        from torch_actor_critic_tpu.sac.ondevice import (
+            PopulationOnDeviceLoop,
+            _wrap_and_build,
+        )
+
+        if pop["members"] % n_avail:
+            raise ValueError(
+                f"population 8 not divisible by {n_avail} devices"
+            )
+        cfg = SACConfig(batch_size=BATCH, hidden_sizes=HIDDEN)
+        env_cls, sac = _wrap_and_build(PendulumJax, cfg)
+        p_flops = sac_flops_per_step(
+            batch=BATCH, hidden=HIDDEN, obs=PendulumJax.obs_dim,
+            act=PendulumJax.act_dim,
+        )
+        loop = PopulationOnDeviceLoop(
+            sac, env_cls, n_members=pop["members"], n_envs=8,
+            mesh=make_mesh() if n_avail > 1 else None,
+        )
+        steps = 2 * BURST
+        ts, buf, es, keys, _ = loop.init(
+            jax.random.key(0), buffer_capacity=20_000
+        )
+        ts, buf, es, keys, _ = loop.epoch(
+            ts, buf, es, keys, steps=BURST, update_every=BURST, warmup=True
+        )
+        ts, buf, es, keys, m = loop.epoch(
+            ts, buf, es, keys, steps=steps, update_every=BURST
+        )
+        drain(m["loss_q"])
+        t0 = time.perf_counter()
+        ts, buf, es, keys, m = loop.epoch(
+            ts, buf, es, keys, steps=steps, update_every=BURST
+        )
+        drain(m["loss_q"])
+        dt = time.perf_counter() - t0
+        agg = steps * pop["members"] / dt
+        pop["grad_steps_per_sec_aggregate"] = round(agg, 1)
+        pop["env_steps_per_sec_aggregate"] = round(
+            steps * 8 * pop["members"] / dt, 1
+        )
+        if peak:
+            # Per-device MFU: each device grinds members/n_avail curves.
+            pop["est_mfu_per_device"] = round(
+                agg / max(n_avail, 1) * p_flops / peak, 5
+            )
+    except Exception as e:  # noqa: BLE001
+        pop["error"] = repr(e)[:200]
+    log(f"sharding population {pop}")
+    return out
+
+
 def bench_unroll(budget_s=300.0):
     """Burst-scan unroll tuning at the headline config: the per-step
     kernels are launch-bound at batch 64 x [256,256], so unrolling the
@@ -1647,6 +1796,7 @@ _STAGES = {
     "headline": _stage_headline,
     "headline_bf16": _stage_headline_bf16,
     "sweep": lambda: {"sweep": bench_sweep()},
+    "sharding": lambda: {"sharding": bench_sharding()},
     "unroll": lambda: {"burst_unroll": bench_unroll()},
     "td3": lambda: {"td3": bench_td3()},
     # Both population sub-stages share the one subprocess timeout
@@ -1683,6 +1833,19 @@ _STAGES = {
 
 def _run_stage_inprocess(name):
     """Child-process mode: run one stage, print one JSON line, exit 0."""
+    if (
+        name == "sharding"
+        and os.environ.get("TAC_BENCH_CHILD_PLATFORM") == "cpu"
+        and "host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
+    ):
+        # The mesh stage is meaningless on one device; on the CPU
+        # fallback give this child the same forced-device shim tier-1
+        # uses (must precede the first jax import, which happens in
+        # _ensure_platform below).
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4"
+        ).strip()
     # Honor the parent's preflight decision: if it fell back to CPU, a
     # fresh import here would still default to the (dead) accelerator.
     _ensure_platform(os.environ.get("TAC_BENCH_CHILD_PLATFORM"))
@@ -1848,7 +2011,8 @@ def main():
         for stage, timeout_s in (
             # attention runs two lengths with 180s internal budgets
             # each; its timeout covers both plus init + compiles.
-            ("sweep", 900), ("unroll", 420), ("td3", 420),
+            ("sweep", 900), ("sharding", 540), ("unroll", 420),
+            ("td3", 420),
             ("population", 720), ("on_device", 540), ("attention", 900),
         ):
             res = run_stage_subprocess(

@@ -19,7 +19,7 @@ from torch_actor_critic_tpu.parallel import (
     make_mesh,
     shard_chunk,
 )
-from torch_actor_critic_tpu.parallel.compat import shard_map
+from torch_actor_critic_tpu.parallel.context import manual_shard_map as shard_map
 from torch_actor_critic_tpu.sac import SAC
 from torch_actor_critic_tpu.utils.config import SACConfig
 
@@ -52,11 +52,15 @@ def make_chunk(key, n_dev, per_dev):
 
 def test_mesh_shapes():
     mesh = make_mesh(dp=4, tp=2)
-    assert mesh.shape == {"dp": 4, "tp": 2, "sp": 1}
+    assert mesh.shape == {"dp": 4, "fsdp": 1, "tp": 2, "sp": 1}
     mesh = make_mesh()
     assert mesh.shape["dp"] == 8
     mesh = make_mesh(dp=2, sp=4)
-    assert mesh.shape == {"dp": 2, "tp": 1, "sp": 4}
+    assert mesh.shape == {"dp": 2, "fsdp": 1, "tp": 1, "sp": 4}
+    mesh = make_mesh(dp=2, fsdp=4)
+    assert mesh.shape == {"dp": 2, "fsdp": 4, "tp": 1, "sp": 1}
+    # fsdp participates in the all-devices default split.
+    assert make_mesh(fsdp=2).shape["dp"] == 4
 
 
 def test_local_dp_info_rejects_zero_slice_process(monkeypatch):
@@ -246,11 +250,10 @@ def test_tp_collective_count_in_hlo():
 
 def test_dp_tp_hybrid_matches_dp_only():
     """A (dp=4, tp=2) burst must compute the same update as (dp=4,
-    tp=1): tensor parallelism changes layout, not math."""
-    if not hasattr(jax, "shard_map"):
-        # The legacy experimental shard_map miscompiles partially-auto
-        # meshes (see DataParallelSAC._build_burst's version gate).
-        pytest.skip("dp+tp hybrid burst needs native jax.shard_map (jax>=0.5)")
+    tp=1): tensor parallelism changes layout, not math. No version
+    gate: the GSPMD burst runs the hybrid under plain auto
+    partitioning on every supported jax (the legacy shard_map
+    partial-auto mode that miscompiled is gone from the hot path)."""
     cfg = SACConfig(hidden_sizes=(32, 32), batch_size=8)
 
     def run(tp):

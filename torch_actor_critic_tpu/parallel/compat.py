@@ -1,71 +1,37 @@
-"""JAX version-compat shims for the manual-sharding API.
+"""DEPRECATED shard_map version shim — no longer on any hot path.
 
-The framework is written against the modern ``jax.shard_map`` surface
-(top-level export; ``axis_names=`` for partially-manual meshes;
-``check_vma=`` replication checking). Older jax releases (<= 0.4.x, e.g.
-the 0.4.37 this image ships) only have
-``jax.experimental.shard_map.shard_map`` with the inverse parameter
-convention: ``auto=`` names the axes that STAY automatic (GSPMD) rather
-than the axes that become manual, and the replication check is spelled
-``check_rep``.
+The data-parallel update burst (``parallel/dp.py``), the fused
+on-device epoch (``sac/ondevice.py``) and the population loop were
+rebuilt on the modern GSPMD surface — ``jax.sharding.Mesh`` +
+``NamedSharding`` + ``jit`` with ``in_shardings``/``out_shardings`` +
+``with_sharding_constraint`` — so nothing version-sensitive remains on
+those paths and the dp+tp/fsdp hybrid runs under plain auto
+partitioning on every supported jax.
 
-:func:`shard_map` here accepts the modern signature and translates:
+Ring attention (``parallel/context.py``) is the one surface that is
+manual by nature; its version-tolerant wrapper now lives there as
+:func:`~torch_actor_critic_tpu.parallel.context.manual_shard_map`.
 
-- present natively -> forwarded verbatim to ``jax.shard_map``;
-- legacy fallback -> ``axis_names`` complemented against
-  ``mesh.axis_names`` into ``auto``, ``check_vma`` renamed to
-  ``check_rep``.
-
-Every call site in the package (``parallel/dp.py``,
-``parallel/context.py``, ``sac/ondevice.py``) and the distributed tests
-route through this module, so a jax upgrade is a one-file audit.
+This module remains only as an import-compatible alias so the
+substrate-parity pin (``tests/test_mesh_gspmd.py``) can rebuild the
+*legacy* shard_map burst and prove the GSPMD rewrite was a pure
+substrate swap. New code must not import it.
 """
 
 from __future__ import annotations
 
-import typing as t
+import warnings
 
-import jax
+from torch_actor_critic_tpu.parallel.context import (  # noqa: F401
+    manual_shard_map as shard_map,
+)
 
 __all__ = ["shard_map"]
 
-
-def shard_map(
-    f: t.Callable,
-    *,
-    mesh,
-    in_specs,
-    out_specs,
-    axis_names: t.Optional[t.AbstractSet[str]] = None,
-    check_vma: t.Optional[bool] = None,
-):
-    """``jax.shard_map`` with a fallback onto the legacy experimental API.
-
-    ``axis_names``: the mesh axes the body sees as MANUAL collectives
-    axes; every other mesh axis stays a GSPMD auto axis (None = all
-    manual — both APIs' default). ``check_vma``: enable the
-    varying-manual-axes / replication check (None = API default).
-    """
-    native = getattr(jax, "shard_map", None)
-    if native is not None:
-        kwargs: dict = {}
-        if axis_names is not None:
-            kwargs["axis_names"] = set(axis_names)
-        if check_vma is not None:
-            kwargs["check_vma"] = check_vma
-        return native(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
-        )
-
-    from jax.experimental.shard_map import shard_map as legacy
-
-    kwargs = {}
-    if check_vma is not None:
-        kwargs["check_rep"] = check_vma
-    if axis_names is not None:
-        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
-        if auto:
-            kwargs["auto"] = auto
-    return legacy(
-        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
-    )
+warnings.warn(
+    "torch_actor_critic_tpu.parallel.compat is deprecated: the dp/fused "
+    "hot paths are plain GSPMD jit now; import manual_shard_map from "
+    "parallel.context for the (ring-attention) manual surface.",
+    DeprecationWarning,
+    stacklevel=2,
+)

@@ -1,4 +1,4 @@
-"""Synchronous data-parallel SAC over a device mesh.
+"""Synchronous data-parallel SAC over a named device mesh.
 
 The TPU-native re-design of the reference's MPI data parallelism
 (SURVEY.md §2): each worker owns a model replica, its own env stream
@@ -20,15 +20,28 @@ per-rank replica + buffer          replicated params, ``dp``-sharded
 ``sync_params`` Bcast              params device_put replicated once;
                                    pmean'd grads keep replicas
                                    bit-identical thereafter
-per-rank seeds ``10000*rank``      ``fold_in(rng, axis_index('dp'))``
-per-step stat send/recv            metrics ``pmean`` in-program (the
+per-rank seeds ``10000*rank``      ``fold_in(rng, device_index)``
+per-step stat send/recv            metrics reduced in-program (the
                                    reference's per-step blocking
                                    exchange, ref ``algorithm.py:262-271``,
                                    moves off the hot path entirely)
 ================================  =====================================
 
-The whole N-device burst — push N env chunks, run K gradient steps with
-cross-device averaging — is ONE ``shard_map``-ped jitted call.
+Substrate (the PR-8 rebuild): the whole N-device burst — push N env
+chunks, run K gradient steps with cross-device averaging — is ONE
+jitted program on the **GSPMD auto-partitioning surface**:
+``jax.jit`` with ``in_shardings``/``out_shardings`` over
+``NamedSharding`` trees, ``with_sharding_constraint`` pinning the
+parameter layout (:func:`~torch_actor_critic_tpu.parallel.sharding.
+param_specs` — tp roles + size-thresholded fsdp), and the per-device
+view expressed as ``jax.vmap(..., axis_name='dp')`` over the leading
+device axis so ``lax.pmean``/``pmax``/``pmin`` keep their named-axis
+spelling while XLA inserts the actual collectives. No ``shard_map``,
+no version shims, and the dp+tp/fsdp hybrid needs no partial-auto
+mode — it is ordinary auto partitioning, so the legacy version gate is
+gone. Ring-attention sequence parallelism (``sp``) is the one manual
+algorithm left; that burst routes through
+:func:`~torch_actor_critic_tpu.parallel.context.manual_shard_map`.
 """
 
 from __future__ import annotations
@@ -39,15 +52,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from torch_actor_critic_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from torch_actor_critic_tpu.buffer.replay import init_replay_buffer
+from torch_actor_critic_tpu.buffer.replay import init_replay_buffer, push
 from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
 from torch_actor_critic_tpu.diagnostics import ingraph as diag
 from torch_actor_critic_tpu.parallel import sharding as tp_sharding
 from torch_actor_critic_tpu.parallel.mesh import global_device_put
-from torch_actor_critic_tpu.sac.algorithm import SAC, Metrics
 
 # Per-device metrics whose cross-replica spread (pmax - pmin) is the
 # replica-desync leading indicator (docs/OBSERVABILITY.md): param-norm
@@ -55,11 +66,9 @@ from torch_actor_critic_tpu.sac.algorithm import SAC, Metrics
 # bit-identical; grad-norm skew tracks per-shard batch disagreement.
 _SKEW_KEYS = ("diag/grad_norm_q", "diag/grad_norm_pi", "diag/param_norm")
 
-
-def _dp_specs(mesh: Mesh):
-    dp_spec = P("dp")
-    rep_spec = P()
-    return dp_spec, rep_spec
+# Replicated-rng fold constant: the post-burst state carries one rng
+# stream derived from the pre-burst key, identical on every device.
+_RNG_FOLD = 0xB0057
 
 
 def _leaf_spec(leaf, sp: int) -> P:
@@ -104,6 +113,14 @@ def _buffer_specs(buffer: BufferState, sp: int) -> BufferState:
     )
 
 
+def _shardings(mesh: Mesh, specs: t.Any) -> t.Any:
+    """PartitionSpec tree -> NamedSharding tree on ``mesh``."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
 def init_sharded_buffer(
     capacity_per_device: int,
     obs_spec: t.Any,
@@ -121,7 +138,7 @@ def init_sharded_buffer(
 
     ``sp`` overrides the sequence-sharding factor — pass
     ``DataParallelSAC.effective_sp`` so at-rest layout always agrees
-    with the burst's shard_map specs (a non-sequence model on an sp>1
+    with the burst's compiled specs (a non-sequence model on an sp>1
     mesh must keep dp-only layout or every burst would reshard).
     """
     n_dev = mesh.shape["dp"]
@@ -193,16 +210,28 @@ class DataParallelSAC:
     Single-device training is just ``dp=1`` — one code path, no
     "degrades to no-ops when world size is 1" special-casing (cf. ref
     ``sac/mpi.py:79-80,94-95``).
+
+    ``fsdp_min_bytes`` is the parameter-size threshold below which the
+    ``fsdp`` axis replicates instead of sharding
+    (:func:`~torch_actor_critic_tpu.parallel.sharding.fsdp_spec`);
+    tiny-model tests pass 0 to force real sharding.
     """
 
     AXIS = "dp"
 
-    def __init__(self, sac: SAC, mesh: Mesh):
+    def __init__(
+        self, sac, mesh: Mesh, fsdp_min_bytes: int | None = None
+    ):
         self.sac = sac
         self.mesh = mesh
         self.n_devices = mesh.shape["dp"]
+        self.fsdp = mesh.shape.get("fsdp", 1)
         self.tp = mesh.shape.get("tp", 1)
         self.sp = mesh.shape.get("sp", 1)
+        self.fsdp_min_bytes = (
+            tp_sharding.FSDP_MIN_BYTES
+            if fsdp_min_bytes is None else fsdp_min_bytes
+        )
         # Sequence/context parallelism in the GRADIENT path: on an sp>1
         # mesh with sequence models (identified by their injectable
         # attention_fn), the burst runs the actor/critic applies inside
@@ -216,6 +245,7 @@ class DataParallelSAC:
             from torch_actor_critic_tpu.parallel.context import (
                 make_ring_attention_fn,
             )
+            from torch_actor_critic_tpu.sac.algorithm import SAC
 
             ring = make_ring_attention_fn("sp", self.sp)
             self.sac_sp = SAC(
@@ -268,49 +298,153 @@ class DataParallelSAC:
     # ----------------------------------------------------------- state init
 
     def init_state(self, key: jax.Array, example_obs: t.Any) -> TrainState:
-        """Initialize once and replicate across the mesh — the moral
-        equivalent of rank-0 init + ``sync_params`` Bcast
-        (ref ``sac/algorithm.py:198-200``); thereafter pmean'd grads
-        keep every replica bit-identical. On a ``tp>1`` mesh, weight
-        matrices land tensor-sharded (dp-replicated, tp-partitioned)
-        per :func:`~torch_actor_critic_tpu.parallel.sharding.tp_specs`."""
+        """Initialize once and place on the mesh — the moral equivalent
+        of rank-0 init + ``sync_params`` Bcast (ref
+        ``sac/algorithm.py:198-200``); thereafter pmean'd grads keep
+        every replica bit-identical. Weight matrices land tensor- or
+        fsdp-sharded per :func:`~torch_actor_critic_tpu.parallel.
+        sharding.param_specs` (replicated on a trivial mesh)."""
         state = self.sac.init_state(key, example_obs)
-        if self.tp > 1:
-            return tp_sharding.shard_params(state, self.mesh)
-        rep = NamedSharding(self.mesh, P())
-        return jax.tree_util.tree_map(lambda x: global_device_put(x, rep), state)
+        return tp_sharding.shard_params(
+            state, self.mesh, self.fsdp_min_bytes
+        )
+
+    def _state_shardings(self, state: TrainState) -> t.Any:
+        """Per-leaf NamedShardings of the at-rest TrainState layout —
+        the jit ``in_shardings``/``out_shardings`` for the state slot,
+        matching :meth:`init_state`'s placement exactly so the donated
+        buffers are reusable and nothing reshards between bursts."""
+        specs = tp_sharding.param_specs(
+            state, self.mesh, self.fsdp_min_bytes
+        )
+        return _shardings(self.mesh, specs)
 
     # ----------------------------------------------------------- the burst
 
-    def _build_burst(self, num_updates: int, buffer: BufferState, chunk: Batch):
-        sac = self.sac_sp if self._sp_active else self.sac
-        mesh = self.mesh
-        _, rep_spec = _dp_specs(mesh)
-        sp = self.effective_sp
+    def _build_burst(
+        self, num_updates: int, state: TrainState, buffer: BufferState,
+        chunk: Batch,
+    ):
+        """The GSPMD burst: one ``jit`` with explicit shardings.
+
+        The per-device view of the old manual code — strip the device
+        axis, fold the device index into the rng, run the shared
+        ``update_burst`` with ``axis_name='dp'`` — is expressed as
+        ``jax.vmap(..., axis_name='dp')`` over the leading device axis:
+        identical per-device math and key streams (pinned bitwise by
+        the substrate-parity test), with the ``lax.pmean`` resolving
+        against the vmap axis and XLA's partitioner emitting the actual
+        cross-device all-reduce because that axis is sharded ``P('dp')``.
+        """
         if self._sp_active:
-            self._check_sp_shapes(chunk)
+            return self._build_ring_burst(num_updates, buffer, chunk)
+        sac = self.sac
+        mesh = self.mesh
+        n_dev = self.n_devices
+        min_bytes = self.fsdp_min_bytes
+        buf_sh = _shardings(mesh, _buffer_specs(buffer, 1))
+        chunk_sh = _shardings(mesh, _batch_specs(chunk, 1))
+        state_sh = self._state_shardings(state)
+        rep = NamedSharding(mesh, P())
+
+        def burst(state: TrainState, buffer: BufferState, chunk: Batch):
+            # Pin the parameter layout (tp/fsdp specs) for the
+            # partitioner; trivial meshes pass through untouched.
+            state = tp_sharding.constrain(state, mesh, min_bytes)
+
+            def per_device(dev, buf, ch):
+                # Decorrelate per-device noise/sampling streams — the
+                # analogue of per-rank seeds (ref sac/algorithm.py:
+                # 203-205). Fold in the dp index ONLY: params stay
+                # shared (closed over, unbatched under vmap).
+                local = state.replace(
+                    rng=jax.random.fold_in(state.rng, dev)
+                )
+                local, buf, metrics = sac.update_burst(
+                    local, buf, ch, num_updates,
+                    axis_name=DataParallelSAC.AXIS,
+                )
+                if sac.config.diagnostics == "off":
+                    # Parity path: the historical whole-tree pmean,
+                    # traced bit-identically to a build without
+                    # diagnostics.
+                    metrics = jax.lax.pmean(
+                        metrics, DataParallelSAC.AXIS
+                    )
+                else:
+                    skew = (
+                        diag.replica_skew(
+                            metrics, _SKEW_KEYS, DataParallelSAC.AXIS
+                        )
+                        if n_dev > 1 else {}
+                    )
+                    # Suffix-aware collectives: per-burst maxima stay
+                    # maxima across replicas, histogram counts add.
+                    metrics = diag.cross_replica_reduce(
+                        metrics, DataParallelSAC.AXIS
+                    )
+                    metrics.update(skew)
+                return local, buf, metrics
+
+            locals_out, buffer, metrics = jax.vmap(
+                per_device, axis_name=DataParallelSAC.AXIS
+            )(jnp.arange(n_dev), buffer, chunk)
+            # Params/opt-states are replicated (pmean'd grads keep the
+            # per-device copies bit-identical); collapse the device
+            # axis and restore a replicated rng stream derived from the
+            # pre-burst key so the output TrainState is one logical
+            # value.
+            state_out = jax.tree_util.tree_map(
+                lambda x: x[0], locals_out
+            )
+            state_out = state_out.replace(
+                rng=jax.random.fold_in(state.rng, jnp.uint32(_RNG_FOLD))
+            )
+            metrics = jax.tree_util.tree_map(lambda x: x[0], metrics)
+            return state_out, buffer, metrics
+
+        return jax.jit(
+            burst,
+            in_shardings=(state_sh, buf_sh, chunk_sh),
+            out_shardings=(state_sh, buf_sh, rep),
+            donate_argnums=(0, 1),
+        )
+
+    def _build_ring_burst(
+        self, num_updates: int, buffer: BufferState, chunk: Batch
+    ):
+        """The sp (ring-attention) burst: manual by nature — the K/V
+        rotation needs a real named manual axis — so it keeps a
+        ``shard_map`` via :func:`~torch_actor_critic_tpu.parallel.
+        context.manual_shard_map`. On the legacy jax API every
+        non-manual axis must be size 1 (the partial-auto mode
+        miscompiles); tp/fsdp therefore cannot combine with sp there.
+        """
+        from torch_actor_critic_tpu.parallel.context import manual_shard_map
+
+        sac = self.sac_sp
+        mesh = self.mesh
+        sp = self.effective_sp
+        self._check_sp_shapes(chunk)
         # Grad/metric averaging axes: per-rank grads need pmean over dp
-        # (data-parallel shards, as the reference's mpi_avg_grads) AND —
-        # when the sequence ring is in the loss path — over sp (see
+        # (data-parallel shards, as the reference's mpi_avg_grads) AND
+        # over sp (the sequence ring is in the loss path — see
         # __init__ note).
-        axes = ("dp", "sp") if self._sp_active else "dp"
-        manual = {"dp", "sp"} if self._sp_active else {"dp"}
+        axes = ("dp", "sp")
+        manual = {"dp", "sp"}
         if not hasattr(jax, "shard_map") and any(
             mesh.shape[a] > 1 for a in mesh.axis_names if a not in manual
         ):
-            # jax <= 0.4.x (parallel/compat.py fallback): the
-            # experimental shard_map's partially-automatic mode
-            # miscompiles this burst (typed-PRNG-key output shardings,
-            # PartitionId lowering, and past those an XLA CHECK abort
-            # that takes the process down). Fail loudly up front.
             raise NotImplementedError(
-                f"dp+tp hybrid parallelism needs jax.shard_map with "
-                f"partial-auto axis support (jax >= 0.5); this jax "
-                f"{jax.__version__} only runs fully-manual meshes — "
-                "set tp=1 or upgrade jax."
+                f"sp ring attention with tp/fsdp needs jax.shard_map "
+                f"with partial-auto axis support (jax >= 0.5); this jax "
+                f"{jax.__version__} only runs the ring on fully-manual "
+                "meshes — set tp=1 and fsdp=1, or upgrade jax."
             )
+        min_bytes = self.fsdp_min_bytes
         buf_specs = _buffer_specs(buffer, sp)
         chunk_specs = _batch_specs(chunk, sp)
+        rep_spec = P()
 
         def burst_body(state: TrainState, buffer: BufferState, chunk: Batch):
             # Per-shard view: strip the leading device axis shard_map
@@ -318,52 +452,40 @@ class DataParallelSAC:
             buffer = jax.tree_util.tree_map(lambda x: x[0], buffer)
             chunk = jax.tree_util.tree_map(lambda x: x[0], chunk)
 
-            # Decorrelate per-device noise/sampling streams — the
-            # analogue of per-rank seeds (ref sac/algorithm.py:203-205).
             # Fold in dp ONLY: all sp ranks of one replica must draw the
             # same replay rows / action noise (the sequence is sharded,
             # the batch is not).
             dev = jax.lax.axis_index(DataParallelSAC.AXIS)
             local = state.replace(rng=jax.random.fold_in(state.rng, dev))
-            # tp is a GSPMD *auto* axis inside this manual body:
-            # re-assert the Megatron layout and the partitioner shards
-            # every matmul of the fused step, collectives included.
-            local = tp_sharding.constrain(local, mesh)
+            # tp/fsdp are GSPMD *auto* axes inside this manual body
+            # (size 1 on the legacy API): re-assert the parameter
+            # layout for the partitioner.
+            local = tp_sharding.constrain(local, mesh, min_bytes)
 
             local, buffer, metrics = sac.update_burst(
                 local, buffer, chunk, num_updates, axis_name=axes
             )
-            # Params/opt-states are replicated (pmean'd grads); restore a
-            # replicated rng stream derived from the pre-burst key so the
-            # output TrainState is identical on every device.
             state_out = local.replace(
-                rng=jax.random.fold_in(state.rng, jnp.uint32(0xB0057))
+                rng=jax.random.fold_in(state.rng, jnp.uint32(_RNG_FOLD))
             )
             if sac.config.diagnostics == "off":
-                # Parity path: the historical whole-tree pmean, traced
-                # bit-identically to a build without diagnostics.
                 metrics = jax.lax.pmean(metrics, axes)
             else:
                 skew = (
                     diag.replica_skew(metrics, _SKEW_KEYS, "dp")
                     if mesh.shape["dp"] > 1 else {}
                 )
-                # Suffix-aware collectives: per-burst maxima stay
-                # maxima across replicas, histogram counts add.
                 metrics = diag.cross_replica_reduce(metrics, axes)
                 metrics.update(skew)
             # Re-attach the device axis for the dp-sharded outputs.
             buffer = jax.tree_util.tree_map(lambda x: x[None], buffer)
             return state_out, buffer, metrics
 
-        mapped = shard_map(
+        mapped = manual_shard_map(
             burst_body,
             mesh=mesh,
             in_specs=(rep_spec, buf_specs, chunk_specs),
             out_specs=(rep_spec, buf_specs, rep_spec),
-            # Manual collectives over dp (and sp when the ring runs in
-            # the losses); tp stays a GSPMD auto axis so
-            # with_sharding_constraint works inside.
             axis_names=manual,
             check_vma=False,
         )
@@ -380,14 +502,14 @@ class DataParallelSAC:
         buffer: BufferState,
         chunk: Batch,
         num_updates: int,
-    ) -> t.Tuple[TrainState, BufferState, Metrics]:
+    ) -> t.Tuple[TrainState, BufferState, t.Dict[str, jax.Array]]:
         """Push per-device chunks and run ``num_updates`` DP gradient
         steps as one device dispatch. ``chunk`` leaves have leading axes
         ``(n_dev, per_dev, ...)`` (see :func:`shard_chunk`)."""
         if self._burst is None or self._burst[0] != num_updates:
             self._burst = (
                 num_updates,
-                self._build_burst(num_updates, buffer, chunk),
+                self._build_burst(num_updates, state, buffer, chunk),
             )
         return self._burst[1](state, buffer, chunk)
 
@@ -403,30 +525,22 @@ class DataParallelSAC:
         """Store per-device chunks without gradient steps — the warmup
         path before ``update_after`` (the reference stores every step
         but only updates after warmup, ref ``sac/algorithm.py:249,273``).
+
+        Pure per-ring data movement (no collectives): ``jax.vmap`` of
+        the single-ring ``push`` over the device axis, jitted with the
+        at-rest shardings.
         """
         if self._push is None:
-            from torch_actor_critic_tpu.buffer.replay import push
-
             sp = self.effective_sp
             if self._sp_active:
                 self._check_sp_shapes(chunk)
-            buf_specs = _buffer_specs(buffer, sp)
-            chunk_specs = _batch_specs(chunk, sp)
-
-            def body(buffer, chunk):
-                buffer = jax.tree_util.tree_map(lambda x: x[0], buffer)
-                chunk = jax.tree_util.tree_map(lambda x: x[0], chunk)
-                out = push(buffer, chunk)
-                return jax.tree_util.tree_map(lambda x: x[None], out)
+            buf_sh = _shardings(self.mesh, _buffer_specs(buffer, sp))
+            chunk_sh = _shardings(self.mesh, _batch_specs(chunk, sp))
 
             self._push = jax.jit(
-                shard_map(
-                    body,
-                    mesh=self.mesh,
-                    in_specs=(buf_specs, chunk_specs),
-                    out_specs=buf_specs,
-                    check_vma=False,
-                ),
+                jax.vmap(push),
+                in_shardings=(buf_sh, chunk_sh),
+                out_shardings=buf_sh,
                 donate_argnums=(0,),
             )
         return self._push(buffer, chunk)

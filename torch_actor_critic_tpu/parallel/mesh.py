@@ -6,7 +6,15 @@ The TPU-native replacement for the reference's MPI world
 
 - ``dp`` — data parallelism: per-device replay shards + batches,
   gradients averaged with ``lax.pmean`` (the reference's one strategy,
-  SURVEY.md §2 "Parallelism strategies").
+  SURVEY.md §2 "Parallelism strategies"). Also the axis the fused
+  population loop shards its member dimension over
+  (:class:`~torch_actor_critic_tpu.sac.ondevice.PopulationOnDeviceLoop`).
+- ``fsdp`` — fully-sharded data parallelism: parameters above a size
+  threshold sharded over their largest divisible dimension, scalars
+  and small arrays replicated
+  (:func:`~torch_actor_critic_tpu.parallel.sharding.fsdp_spec`); the
+  GSPMD partitioner inserts the gathers around each use. ``fsdp=1``
+  (default) replicates everything — pure DP.
 - ``tp`` — tensor parallelism for wide models: parameters sharded over
   hidden dimensions via GSPMD annotations
   (:mod:`torch_actor_critic_tpu.parallel.sharding`). An extension
@@ -40,7 +48,7 @@ def local_dp_info(mesh: Mesh) -> t.Tuple[int, int]:
     """``(n_local_slices, first_local_slice)`` of the ``dp`` axis for
     this process.
 
-    A "slice" is one dp index (its ``tp × sp`` device block). The host
+    A "slice" is one dp index (its ``fsdp × tp × sp`` device block). The host
     loop steps ONE env per *local* dp slice — each process simulates
     only the envs whose replay shards it can address, the analogue of
     the reference's one-env-per-MPI-rank pairing (SURVEY.md §2) without
@@ -64,8 +72,9 @@ def local_dp_info(mesh: Mesh) -> t.Tuple[int, int]:
         elif pi in procs:
             raise ValueError(
                 f"dp slice {i} spans processes {sorted(procs)}; lay out "
-                "the mesh so each dp slice (its tp*sp block) is owned by "
-                "one process (tp*sp must divide the local device count)."
+                "the mesh so each dp slice (its fsdp*tp*sp block) is "
+                "owned by one process (fsdp*tp*sp must divide the local "
+                "device count)."
             )
     if not mine:
         # A process with zero dp slices would build a 0-env pool and
@@ -75,9 +84,9 @@ def local_dp_info(mesh: Mesh) -> t.Tuple[int, int]:
         raise ValueError(
             f"process {pi} owns no complete dp slice of mesh "
             f"{dict(mesh.shape)}: with {jax.process_count()} processes, "
-            "tp*sp must not exceed the local device count and dp must "
-            "be >= the process count so every process gets at least one "
-            "slice (e.g. lower tp/sp or raise dp in make_mesh)."
+            "fsdp*tp*sp must not exceed the local device count and dp "
+            "must be >= the process count so every process gets at least "
+            "one slice (e.g. lower fsdp/tp/sp or raise dp in make_mesh)."
         )
     offset = mine[0]
     if mine != list(range(offset, offset + len(mine))):
@@ -116,25 +125,31 @@ def make_mesh(
     dp: int | None = None,
     tp: int = 1,
     sp: int = 1,
+    fsdp: int = 1,
     devices: t.Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """Build a ``(dp, tp, sp)`` mesh.
+    """Build a ``(dp, fsdp, tp, sp)`` mesh.
 
-    ``dp=None`` uses all available devices (divided by ``tp * sp``).
-    ``sp`` then ``tp`` vary fastest so sequence-ring and tensor
-    collectives ride ICI neighbors; ``dp`` allreduces span the slower
-    links, matching their once-per-burst cadence.
+    ``dp=None`` uses all available devices (divided by
+    ``fsdp * tp * sp``). ``sp`` then ``tp`` then ``fsdp`` vary fastest
+    so sequence-ring, tensor and parameter-gather collectives ride ICI
+    neighbors; ``dp`` allreduces span the slower links, matching their
+    once-per-burst cadence.
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
+    inner = fsdp * tp * sp
     if dp is None:
-        if n % (tp * sp) != 0:
-            raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
-        dp = n // (tp * sp)
-    if dp * tp * sp > n:
+        if n % inner != 0:
+            raise ValueError(
+                f"{n} devices not divisible by fsdp*tp*sp={inner}"
+            )
+        dp = n // inner
+    if dp * inner > n:
         raise ValueError(
-            f"mesh ({dp}x{tp}x{sp}) needs {dp * tp * sp} devices, have {n}"
+            f"mesh ({dp}x{fsdp}x{tp}x{sp}) needs {dp * inner} devices, "
+            f"have {n}"
         )
-    grid = np.asarray(devices[: dp * tp * sp]).reshape(dp, tp, sp)
-    return Mesh(grid, axis_names=("dp", "tp", "sp"))
+    grid = np.asarray(devices[: dp * inner]).reshape(dp, fsdp, tp, sp)
+    return Mesh(grid, axis_names=("dp", "fsdp", "tp", "sp"))

@@ -70,11 +70,13 @@ class PopulationLearner:
             # mesh must fail loudly (members never shard over those
             # axes), and multi-host must fail before every host starts
             # redundantly simulating the whole population.
-            if mesh.shape.get("tp", 1) > 1 or mesh.shape.get("sp", 1) > 1:
+            if any(
+                mesh.shape.get(a, 1) > 1 for a in ("fsdp", "tp", "sp")
+            ):
                 raise ValueError(
                     "population training shards members over the dp mesh "
-                    "axis only; tp/sp axes are not supported inside a "
-                    f"population (mesh shape {dict(mesh.shape)})"
+                    "axis only; fsdp/tp/sp axes are not supported inside "
+                    f"a population (mesh shape {dict(mesh.shape)})"
                 )
             if jax.process_count() > 1:
                 # Multi-host population needs per-process chunk assembly

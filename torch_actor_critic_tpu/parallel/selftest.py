@@ -4,7 +4,9 @@ Exercises the full multi-host surface that single-process tests cannot
 reach (round-1 missing #7): :func:`initialize_multihost` joining the
 runtime, a data-parallel training burst over a mesh spanning processes
 (params replicated globally, replay shards process-local, ``pmean``
-riding the cross-process link), :func:`global_statistics` aggregation,
+riding the cross-process link — since PR 8 the burst is a plain GSPMD
+``jit`` with shardings, so this doubles as the multi-process proof that
+the substrate swap holds off one host), :func:`global_statistics` aggregation,
 coordinator gating, and a COLLECTIVE Orbax checkpoint save + restore
 (every process writes its addressable buffer shards).
 

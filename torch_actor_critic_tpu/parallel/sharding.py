@@ -1,20 +1,26 @@
-"""GSPMD tensor-parallel parameter sharding over the ``tp`` mesh axis.
+"""GSPMD parameter sharding over the ``tp`` and ``fsdp`` mesh axes.
 
 An **extension** beyond the reference's capability envelope (its only
 strategy is MPI data parallelism, SURVEY.md §2 "Parallelism
-strategies"): when a model grows wider than one core's HBM or MXU
-appetite, its weight matrices are sharded across ``tp`` devices and XLA
-inserts the matching collectives. TPU-native design per the scaling-book
-recipe: we only *annotate* shardings — ``PartitionSpec`` on each kernel,
-Megatron-style alternation so consecutive layers compose as
-column-parallel → row-parallel with a single ``psum`` per pair — and the
-GSPMD partitioner materializes the all-reduces on ICI. No manual
-collective code.
+strategies"). Two orthogonal parameter-sharding families compose here:
 
-Composes with the manual-``dp`` path: ``DataParallelSAC`` runs its
-``shard_map`` with ``axis_names={'dp'}``, leaving ``tp`` an *auto* axis
-inside the body, where :func:`constrain` re-applies these specs and XLA
-partitions every matmul of the fused SAC step.
+- ``tp`` — Megatron-style tensor parallelism by explicit per-layer role
+  declaration: ``col`` layers shard their output dim, ``row`` layers
+  their input dim, alternating so consecutive layers compose as
+  column-parallel → row-parallel with a single ``psum`` per pair.
+- ``fsdp`` — size-thresholded fully-sharded data parallelism (the
+  scaling-book recipe): arrays at or above :data:`FSDP_MIN_BYTES` are
+  sharded along their largest dimension evenly divisible by the axis
+  size; scalars, 1-D arrays, small arrays and indivisible shapes stay
+  replicated. With ``fsdp=1`` every parameter is replicated — pure DP.
+
+We only *annotate* shardings — ``PartitionSpec`` per leaf, placed with
+``device_put`` at rest and re-asserted with ``with_sharding_constraint``
+inside the jitted burst — and the GSPMD partitioner materializes the
+matching collectives on ICI. No manual collective code, no
+``shard_map``: the burst in :mod:`torch_actor_critic_tpu.parallel.dp`
+is a plain ``jit`` with ``in_shardings``/``out_shardings`` and these
+specs constrain its parameter layout.
 """
 
 from __future__ import annotations
@@ -26,6 +32,12 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torch_actor_critic_tpu.parallel.mesh import global_device_put
+
+# Minimum array size worth sharding over ``fsdp``: below this the
+# gather traffic costs more than the memory saved (the scaling-book /
+# SNIPPETS.md [2] default). Tests and tiny-model smokes override via
+# the ``min_bytes`` parameter.
+FSDP_MIN_BYTES = 4 * 1024 * 1024
 
 
 def _tp_role(path: t.Tuple) -> str:
@@ -47,7 +59,7 @@ def _tp_role(path: t.Tuple) -> str:
 
 
 def tp_spec(path: t.Tuple, leaf: jax.Array, tp: int) -> P:
-    """PartitionSpec for one parameter leaf.
+    """PartitionSpec for one parameter leaf over the ``tp`` axis only.
 
     Kernels ``(..., in, out)``: a ``col`` layer shards ``out``
     (column-parallel), a ``row`` layer shards ``in`` — whichever is
@@ -71,29 +83,93 @@ def tp_spec(path: t.Tuple, leaf: jax.Array, tp: int) -> P:
 
 
 def tp_specs(params: t.Any, tp: int) -> t.Any:
-    """Pytree of PartitionSpecs matching ``params``."""
+    """Pytree of tp-only PartitionSpecs matching ``params``."""
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: tp_spec(path, leaf, tp), params
     )
 
 
-def shard_params(params: t.Any, mesh: Mesh) -> t.Any:
-    """Place params on the mesh with tensor-parallel shardings (at-rest
-    layout; ``tp=1`` meshes place everything replicated)."""
+def fsdp_spec(
+    leaf: t.Any,
+    fsdp: int,
+    min_bytes: int = FSDP_MIN_BYTES,
+    taken: t.Optional[P] = None,
+) -> P:
+    """Size-thresholded FSDP PartitionSpec for one array leaf.
+
+    The SNIPPETS.md [2] recipe: scalars and 1-D arrays replicate; 2-D+
+    arrays of at least ``min_bytes`` shard ``fsdp`` along the largest
+    dimension evenly divisible by the axis size; when no dimension
+    divides, replicate (fallback). ``taken`` is an existing spec (e.g.
+    a tp assignment) whose occupied dimensions are skipped so the two
+    families compose on disjoint axes.
+    """
+    if fsdp <= 1 or not hasattr(leaf, "shape") or leaf.ndim < 2:
+        return P() if taken is None else taken
+    nbytes = getattr(leaf, "nbytes", None)
+    if nbytes is None:
+        import numpy as np
+
+        nbytes = int(np.prod(leaf.shape)) * jax.dtypes.canonicalize_dtype(
+            leaf.dtype
+        ).itemsize
+    if nbytes < min_bytes:
+        return P() if taken is None else taken
+    base = tuple(taken) if taken is not None else ()
+    base = base + (None,) * (leaf.ndim - len(base))
+    candidates = [
+        (leaf.shape[i], i)
+        for i in range(leaf.ndim)
+        if base[i] is None and leaf.shape[i] % fsdp == 0 and leaf.shape[i] > 1
+    ]
+    if not candidates:
+        return P() if taken is None else taken
+    _, dim = max(candidates)
+    out = list(base)
+    out[dim] = "fsdp"
+    # Strip trailing Nones for the canonical short form.
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def param_specs(
+    params: t.Any, mesh: Mesh, min_bytes: int = FSDP_MIN_BYTES
+) -> t.Any:
+    """Pytree of PartitionSpecs combining both parameter-sharding
+    families on the mesh: tp role specs first, then fsdp on the largest
+    remaining divisible dimension of size-qualified leaves. On a
+    ``tp=1, fsdp=1`` mesh everything is ``P()`` (replicated)."""
     tp = mesh.shape.get("tp", 1)
-    specs = tp_specs(params, tp)
+    fsdp = mesh.shape.get("fsdp", 1)
+
+    def one(path, leaf):
+        # tp=1 stays pure P() (no size-1 axis names cluttering specs).
+        spec = tp_spec(path, leaf, tp) if tp > 1 else P()
+        return fsdp_spec(leaf, fsdp, min_bytes, taken=spec)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def shard_params(
+    params: t.Any, mesh: Mesh, min_bytes: int = FSDP_MIN_BYTES
+) -> t.Any:
+    """Place params on the mesh with tensor-parallel + fsdp shardings
+    (at-rest layout; trivial meshes place everything replicated)."""
+    specs = param_specs(params, mesh, min_bytes)
     return jax.tree_util.tree_map(
         lambda x, s: global_device_put(x, NamedSharding(mesh, s)), params, specs
     )
 
 
-def constrain(params: t.Any, mesh: Mesh) -> t.Any:
+def constrain(
+    params: t.Any, mesh: Mesh, min_bytes: int = FSDP_MIN_BYTES
+) -> t.Any:
     """``with_sharding_constraint`` version of :func:`shard_params`, for
-    use inside traced code where ``tp`` is a GSPMD auto axis."""
-    tp = mesh.shape.get("tp", 1)
-    if tp == 1:
+    use inside traced code where every mesh axis is a GSPMD auto axis."""
+    if mesh.shape.get("tp", 1) == 1 and mesh.shape.get("fsdp", 1) == 1:
         return params
-    specs = tp_specs(params, tp)
+    specs = param_specs(params, mesh, min_bytes)
     return jax.tree_util.tree_map(
         # Only constrain leaves that actually shard: a P() constraint adds
         # nothing, and skipping it keeps non-numeric leaves (PRNG keys,

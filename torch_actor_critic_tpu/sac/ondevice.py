@@ -30,7 +30,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from torch_actor_critic_tpu.buffer.replay import init_replay_buffer, push
 from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
 from torch_actor_critic_tpu.envs.ondevice import EnvState
-from torch_actor_critic_tpu.parallel.compat import shard_map
 from torch_actor_critic_tpu.utils.sync import drain
 from torch_actor_critic_tpu.sac.algorithm import SAC
 
@@ -269,48 +268,59 @@ class OnDeviceLoop:
 
         mesh = self.mesh
         axis = OnDeviceLoop.AXIS
+        n_dp = self.n_dp
 
-        def dp_body(train_state, buffer, env_states, act_key):
-            buffer = jax.tree_util.tree_map(lambda x: x[0], buffer)
-            env_states = jax.tree_util.tree_map(lambda x: x[0], env_states)
-            dev = jax.lax.axis_index(axis)
-            # Per-device streams (the reference's per-rank seeds, ref
-            # sac/algorithm.py:203-205); env randomness already diverges
-            # via the per-env rng in EnvState.
-            local = train_state.replace(
-                rng=jax.random.fold_in(train_state.rng, dev)
-            )
-            key = jax.random.fold_in(act_key, dev)
-            ts, buf, es, _, raw = self._epoch_body(
-                local, buffer, env_states, key,
-                n_windows, update_every, warmup, axis_name=axis,
-            )
-            # pmean'd grads keep params replicated; emit a replicated rng
-            # and act key derived from the pre-epoch values.
+        def dp_epoch(train_state, buffer, env_states, act_key):
+            # The per-device view — strip the device axis, fold the
+            # device index into the rng/act streams, run the shared
+            # epoch body with named-axis collectives — expressed as
+            # ``jax.vmap(axis_name='dp')`` over the leading device
+            # axis; XLA turns the pmean/psum into real cross-device
+            # all-reduces because that axis is sharded P('dp'). Same
+            # math and key streams as the retired shard_map body.
+            def per_device(dev, buf, es):
+                # Per-device streams (the reference's per-rank seeds,
+                # ref sac/algorithm.py:203-205); env randomness already
+                # diverges via the per-env rng in EnvState.
+                local = train_state.replace(
+                    rng=jax.random.fold_in(train_state.rng, dev)
+                )
+                key = jax.random.fold_in(act_key, dev)
+                ts, buf, es, _, raw = self._epoch_body(
+                    local, buf, es, key,
+                    n_windows, update_every, warmup, axis_name=axis,
+                )
+                raw = {
+                    "loss_q": jax.lax.pmean(raw["loss_q"], axis),
+                    "loss_pi": jax.lax.pmean(raw["loss_pi"], axis),
+                    "episodes": jax.lax.psum(raw["episodes"], axis),
+                    "return_sum": jax.lax.psum(raw["return_sum"], axis),
+                }
+                return ts, buf, es, raw
+
+            ts_all, buffer, env_states, raw = jax.vmap(
+                per_device, axis_name=axis
+            )(jnp.arange(n_dp), buffer, env_states)
+            # pmean'd grads keep params replicated (per-device copies
+            # bit-identical); collapse the device axis and emit a
+            # replicated rng and act key derived from the pre-epoch
+            # values.
+            ts = jax.tree_util.tree_map(lambda x: x[0], ts_all)
             ts = ts.replace(
                 rng=jax.random.fold_in(train_state.rng, jnp.uint32(0xB0057))
             )
             key_out = jax.random.fold_in(act_key, jnp.uint32(0xB0057))
-            raw = {
-                "loss_q": jax.lax.pmean(raw["loss_q"], axis),
-                "loss_pi": jax.lax.pmean(raw["loss_pi"], axis),
-                "episodes": jax.lax.psum(raw["episodes"], axis),
-                "return_sum": jax.lax.psum(raw["return_sum"], axis),
-            }
-            buf = jax.tree_util.tree_map(lambda x: x[None], buf)
-            es = jax.tree_util.tree_map(lambda x: x[None], es)
-            return ts, buf, es, key_out, self._finalize_metrics(raw)
+            raw = jax.tree_util.tree_map(lambda x: x[0], raw)
+            return ts, buffer, env_states, key_out, self._finalize_metrics(raw)
 
-        dp_spec, rep = P(axis), P()
-        mapped = shard_map(
-            dp_body,
-            mesh=mesh,
-            in_specs=(rep, dp_spec, dp_spec, rep),
-            out_specs=(rep, dp_spec, dp_spec, rep, rep),
-            axis_names={axis},
-            check_vma=False,
+        dp_sh = NamedSharding(mesh, P(axis))
+        rep = NamedSharding(mesh, P())
+        return jax.jit(
+            dp_epoch,
+            in_shardings=(rep, dp_sh, dp_sh, rep),
+            out_shardings=(rep, dp_sh, dp_sh, rep, rep),
+            donate_argnums=(0, 1),
         )
-        return jax.jit(mapped, donate_argnums=(0, 1))
 
     # Watchdog/cost-registry source name of the fused epoch program —
     # every compile in epoch() is attributed here, and the driver
@@ -399,11 +409,23 @@ class PopulationOnDeviceLoop:
     exploit/explore entirely on device: rank by the return EMA, copy
     params + optimizer state from top-quantile to bottom-quantile
     members, multiplicatively perturb the losers' hyperparameters.
+
+    With a ``mesh``, the member axis itself is the parallelism axis:
+    every leaf of the member-stacked state — params, optimizer states,
+    replay rings, env batches, PRNG streams, PBT score arrays — is
+    sharded ``P('dp')`` on its leading member dimension, so
+    ``n_members`` spread ``n_members/dp`` per device and the vmapped
+    epoch partitions across the mesh with ZERO collectives (members
+    share nothing). Only :meth:`pbt_step`'s exploit gather crosses
+    devices — one GSPMD-inserted collective every ``pbt_every`` epochs
+    when a loser copies a winner that lives on another chip. Requires
+    ``n_members`` divisible by the ``dp`` size and a pure-dp mesh
+    (``fsdp``/``tp``/``sp`` all 1 — members never shard over those).
     """
 
     def __init__(
         self, sac: SAC, env_cls, n_members: int, n_envs: int = 16,
-        pbt: bool = False,
+        pbt: bool = False, mesh: Mesh | None = None,
     ):
         if n_members < 1:
             raise ValueError(f"n_members must be >= 1, got {n_members}")
@@ -412,10 +434,44 @@ class PopulationOnDeviceLoop:
         self.n_members = n_members
         self.n_envs = n_envs
         self.pbt = pbt
+        self.mesh = mesh
+        self._member_sharding = None
+        self._rep_sharding = None
+        if mesh is not None:
+            bad = {
+                a: mesh.shape[a]
+                for a in ("fsdp", "tp", "sp")
+                if mesh.shape.get(a, 1) > 1
+            }
+            if bad:
+                raise ValueError(
+                    "the fused population shards members over the dp "
+                    f"mesh axis only; got non-trivial axes {bad} (mesh "
+                    f"shape {dict(mesh.shape)})"
+                )
+            dp = mesh.shape.get("dp", 1)
+            if n_members % dp != 0:
+                raise ValueError(
+                    f"population={n_members} must divide evenly over "
+                    f"the dp={dp} mesh axis (each device runs "
+                    "members/dp members)"
+                )
+            self._member_sharding = NamedSharding(mesh, P("dp"))
+            self._rep_sharding = NamedSharding(mesh, P())
         self.inner = OnDeviceLoop(sac, env_cls, n_envs=n_envs)
         self._epoch_fns: dict = {}
         self._pbt_fn = None
         self._ema_fn = None
+
+    def _place_members(self, tree):
+        """Shard the leading member axis over ``dp`` (no-op off-mesh)."""
+        if self._member_sharding is None:
+            return tree
+        from torch_actor_critic_tpu.parallel.mesh import global_device_put
+
+        return jax.tree_util.tree_map(
+            lambda x: global_device_put(x, self._member_sharding), tree
+        )
 
     # ------------------------------------------------------------------ init
 
@@ -463,6 +519,18 @@ class PopulationOnDeviceLoop:
             ema_count=jnp.zeros(self.n_members, jnp.int32),
             rng=jax.random.fold_in(key, 0x9B8),
         )
+        if self._member_sharding is not None:
+            state = self._place_members(state)
+            buffer = self._place_members(buffer)
+            env_states = self._place_members(env_states)
+            act_keys = self._place_members(act_keys)
+            # Score/count arrays carry the member axis; the exploit rng
+            # is one shared stream, replicated.
+            pbt_state = PBTState(
+                return_ema=self._place_members(pbt_state.return_ema),
+                ema_count=self._place_members(pbt_state.ema_count),
+                rng=jax.device_put(pbt_state.rng, self._rep_sharding),
+            )
         return state, buffer, env_states, act_keys, pbt_state
 
     def _init_hyperparams(self, key: jax.Array):
@@ -508,7 +576,20 @@ class PopulationOnDeviceLoop:
                 OnDeviceLoop._finalize_metrics(raw),
             )
 
-        return jax.jit(epoch, donate_argnums=(0, 1))
+        if self._member_sharding is None:
+            return jax.jit(epoch, donate_argnums=(0, 1))
+        # Member-sharded: pin the leading member axis to P('dp') on
+        # every input and output, so the vmapped member programs
+        # partition across devices (members share nothing — the epoch
+        # compiles with no collectives) and the donated state/rings
+        # keep their layout across dispatches.
+        mem = self._member_sharding
+        return jax.jit(
+            epoch,
+            in_shardings=(mem, mem, mem, mem),
+            out_shardings=(mem, mem, mem, mem, mem),
+            donate_argnums=(0, 1),
+        )
 
     # Watchdog/cost-registry source of the vmapped population epoch.
     epoch_cost_name = "train/population_epoch"
@@ -636,6 +717,34 @@ class PopulationOnDeviceLoop:
                     ),
                     rng=rng,
                 )
+                if self._member_sharding is not None:
+                    # The exploit gather is the one cross-device
+                    # collective of a sharded population; pin its
+                    # output back to the member layout so the copied
+                    # winners land on the losers' devices instead of
+                    # the whole population gathering anywhere. PRNG-key
+                    # leaves are skipped: with_sharding_constraint on
+                    # extended (key) dtypes trips a physical/logical
+                    # rank mismatch on the installed jax, and the
+                    # losers keep their own streams anyway (rng=st.rng
+                    # below — never gathered).
+                    mem = self._member_sharding
+                    new_state = jax.tree_util.tree_map(
+                        lambda x: x
+                        if jax.dtypes.issubdtype(
+                            x.dtype, jax.dtypes.prng_key
+                        )
+                        else jax.lax.with_sharding_constraint(x, mem),
+                        new_state,
+                    )
+                    new_ps = new_ps.replace(
+                        return_ema=jax.lax.with_sharding_constraint(
+                            new_ps.return_ema, mem
+                        ),
+                        ema_count=jax.lax.with_sharding_constraint(
+                            new_ps.ema_count, mem
+                        ),
+                    )
                 return new_state, new_ps, event
 
             # No donation: the step runs once per pbt_every epochs and
@@ -708,14 +817,18 @@ def _abstract_args(*trees):
 
 
 def _note_epoch_cost(
-    loop, sig, abstract, cost_state, metrics, dt, telemetry, e
+    loop, sig, abstract, cost_state, metrics, dt, telemetry, e,
+    devices: int = 1,
 ):
     """Fused-loop per-epoch cost attribution (telemetry on only):
     register the epoch program's XLA cost analysis once, then add
     ``cost/epoch_*`` metric columns and emit one ``cost`` telemetry
     event for the dispatch that just drained. ``cost_state`` is the
     mutable ``{"registered": bool, "peaks": Peaks|None}`` the driver
-    threads through its loop."""
+    threads through its loop. ``devices`` is the participating mesh
+    size of a sharded epoch program — the whole-program analysis is
+    divided down to per-device FLOPs/bytes so roofline/MFU stays
+    honest against a single chip's peak."""
     from torch_actor_critic_tpu.telemetry.costmodel import (
         Peaks,
         get_cost_registry,
@@ -727,7 +840,9 @@ def _note_epoch_cost(
         cost_state["registered"] = True
         fn = loop.epoch_jit(*sig)
         if fn is not None and abstract:
-            registry.register_jit(loop.epoch_cost_name, fn, *abstract)
+            registry.register_jit(
+                loop.epoch_cost_name, fn, *abstract, devices=devices
+            )
     cost = registry.get(loop.epoch_cost_name)
     if cost is None:
         return
@@ -852,7 +967,7 @@ def train_on_device(
         if telemetry is not None:
             _note_epoch_cost(
                 loop, sig, cost_abstract, cost_state, metrics, dt,
-                telemetry, e,
+                telemetry, e, devices=loop.n_dp,
             )
         if tracker is not None and is_coordinator():
             tracker.log_metrics(metrics, e)
@@ -908,16 +1023,35 @@ def train_population_on_device(
     )
     from torch_actor_critic_tpu.parallel.distributed import is_coordinator
 
+    # Member-axis sharding: on a pure-dp multi-device mesh with a
+    # divisible population, members spread across devices (P('dp') on
+    # the leading member dimension of everything); otherwise fall back
+    # to the single-device layout with a warning so odd populations
+    # keep training.
+    pop_mesh = None
     if mesh is not None and int(np.prod(list(mesh.shape.values()))) > 1:
         import logging
 
-        logging.getLogger(__name__).warning(
-            "the population-fused loop is single-device for now — the "
-            "member axis is the parallelism axis; ignoring the %s-device "
-            "mesh and running the whole population on %s (shard members "
-            "over chips by running one process per device)",
-            int(np.prod(list(mesh.shape.values()))), jax.devices()[0],
-        )
+        dp = mesh.shape.get("dp", 1)
+        non_dp = {
+            a: mesh.shape[a]
+            for a in ("fsdp", "tp", "sp")
+            if mesh.shape.get(a, 1) > 1
+        }
+        if non_dp or config.population % dp != 0:
+            logging.getLogger(__name__).warning(
+                "cannot shard the member axis over mesh %s (members "
+                "shard over dp only and population=%d must divide dp); "
+                "running the whole population on one device",
+                dict(mesh.shape), config.population,
+            )
+        else:
+            pop_mesh = mesh
+            logging.getLogger(__name__).info(
+                "sharding population=%d over dp=%d devices (%d members "
+                "per device)", config.population, dp,
+                config.population // dp,
+            )
     env_cls = get_on_device_env(env_name)
     if env_cls is None:
         raise ValueError(
@@ -928,6 +1062,7 @@ def train_population_on_device(
     loop = PopulationOnDeviceLoop(
         sac, env_cls, n_members=config.population,
         n_envs=config.on_device_envs, pbt=config.pbt_every > 0,
+        mesh=pop_mesh,
     )
     state, buffer, env_states, act_keys, pbt_state = loop.init(
         jax.random.key(seed), buffer_capacity=config.buffer_size
@@ -1021,12 +1156,16 @@ def train_population_on_device(
             * config.updates_per_window * n_members / dt
         )
         if telemetry is not None:
-            # Whole-population program cost: the FLOPs already carry
-            # the member axis (one vmapped executable), so MFU here is
-            # the population's aggregate chip utilization.
+            # Whole-population program cost: the FLOPs carry the member
+            # axis (one vmapped executable); with the member axis
+            # sharded, the per-device divide keeps MFU the aggregate
+            # utilization of ONE chip's slice of the population.
             _note_epoch_cost(
                 loop, sig, cost_abstract, cost_state, metrics, dt,
                 telemetry, e,
+                devices=(
+                    pop_mesh.shape["dp"] if pop_mesh is not None else 1
+                ),
             )
         if pbt_event is not None:
             ev = jax.device_get(pbt_event)

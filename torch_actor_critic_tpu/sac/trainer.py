@@ -653,7 +653,14 @@ class Trainer:
             self._cost_registered = True
             fn = self.dp.burst_jit(self.config.updates_per_window)
             if fn is not None and self._burst_abstract:
-                registry.register_jit(name, fn, *self._burst_abstract)
+                # Whole-mesh program -> per-device cost: the lowered
+                # analysis spans every dp/fsdp/tp participant, so the
+                # registered FLOPs divide by the mesh size and MFU
+                # stays honest against one chip's peak.
+                registry.register_jit(
+                    name, fn, *self._burst_abstract,
+                    devices=int(self.mesh.devices.size),
+                )
         cost = registry.get(name)
         if cost is None:
             return

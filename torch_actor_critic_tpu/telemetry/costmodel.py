@@ -235,6 +235,7 @@ class CostRegistry:
         jit_fn,
         *args,
         compiled: bool = True,
+        devices: int = 1,
         **kwargs,
     ) -> dict | None:
         """Lower ``jit_fn`` at ``args`` (arrays or ShapeDtypeStructs)
@@ -248,9 +249,16 @@ class CostRegistry:
         marked ``expected`` to the recompilation watchdog so it never
         reads as a steady-state anomaly. ``compiled=False`` falls back
         to the pre-optimization (lowered) analysis — FLOPs stay
-        accurate, bytes are an overestimate. Errors are swallowed and
-        recorded (cost accounting must never take training or serving
-        down); returns the registered cost dict or None."""
+        accurate, bytes are an overestimate.
+
+        ``devices`` is the participating mesh size of a GSPMD-sharded
+        program: the analysis covers the whole logical program, so its
+        FLOPs/bytes are divided by ``devices`` to register PER-DEVICE
+        cost — ``roofline``/MFU compare against a single chip's peak,
+        and a dp=8 burst must not read as 8x one chip's work. Errors
+        are swallowed and recorded (cost accounting must never take
+        training or serving down); returns the registered cost dict or
+        None."""
         try:
             from torch_actor_critic_tpu.diagnostics.watchdog import (
                 get_watchdog,
@@ -273,11 +281,15 @@ class CostRegistry:
             cost = _extract_costs(analysis)
             if cost is None:
                 raise ValueError(f"no cost analysis available: {analysis!r}")
+            if devices > 1:
+                cost = {k: v / devices for k, v in cost.items()}
+                cost["devices"] = devices
             self.register(name, cost)
             logger.info(
                 "cost registry: %s = %.3g GFLOPs, %.3g MB accessed "
-                "per call", name, cost["flops"] / 1e9,
+                "per call%s", name, cost["flops"] / 1e9,
                 cost["bytes_accessed"] / 1e6,
+                f" per device (mesh of {devices})" if devices > 1 else "",
             )
             return cost
         except Exception as e:  # noqa: BLE001 — observability must not

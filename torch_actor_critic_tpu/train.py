@@ -60,7 +60,17 @@ def parse_arguments(argv=None) -> argparse.Namespace:
         "--devices",
         type=int,
         default=None,
-        help="Data-parallel width (default: all visible devices)",
+        help="Data-parallel width (default: all visible devices, "
+        "divided by --fsdp)",
+    )
+    parser.add_argument(
+        "--fsdp",
+        type=int,
+        default=1,
+        help="Width of the fsdp mesh axis: parameters above the size "
+        "threshold shard over it (parallel/sharding.py), composing "
+        "with --devices into the dp+fsdp hybrid burst "
+        "(docs/SCALING.md)",
     )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
@@ -166,7 +176,7 @@ def main(argv=None):
             }
         )
 
-    mesh = make_mesh(dp=args.devices)
+    mesh = make_mesh(dp=args.devices, fsdp=args.fsdp)
     checkpointer = Checkpointer(
         tracker.artifact_path("checkpoints"), save_buffer=args.save_buffer
     )
