@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke
+.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -106,6 +106,14 @@ fault-smoke:
 # accepted request (docs/SERVING.md "Overload & degradation").
 chaos-smoke:
 	JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+
+# Fleet smoke: 3-worker CPU fleet through the real `serve.py --fleet`
+# entry point — flood through the router, SIGKILL one worker MID-flood
+# (membership ejects it, in-flight requests fail over, zero accepted
+# drops), rolling /reload across the survivors, aggregated /metrics,
+# graceful SIGTERM teardown (docs/SERVING.md "Fleet").
+fleet-smoke:
+	JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

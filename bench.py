@@ -1560,6 +1560,192 @@ def bench_overload(budget_s=180.0, capacity=64):
     return out
 
 
+def bench_fleet(budget_s=300.0, service_ms=8.0, replica_counts=(1, 2, 4)):
+    """Fleet serving scale-out (docs/SERVING.md "Fleet"): aggregate
+    goodput + tail latency vs engine-replica count through the REAL
+    EngineFleet (per-device engines, least-loaded dispatch, shared
+    admission), plus continuous-vs-group batching p50 at low offered
+    load.
+
+    The engine forward is pinned to a fixed simulated service time
+    (``service_ms`` sleep around the real jitted forward): on the
+    1-core CPU bench host real forwards cannot scale past one core, so
+    the stage measures what actually matters and transfers to real
+    hardware — whether the fleet's dispatch plane OVERLAPS N engines'
+    service times (on a TPU host each replica's forward runs on its
+    own chip; the host-side dispatch path benched here is identical).
+    Scaling ~N in ``scaling_vs_1`` means the dispatcher, shared
+    admission and per-replica queues add no serialization."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torch_actor_critic_tpu.models import Actor
+    from torch_actor_critic_tpu.serve import (
+        EngineFleet,
+        MicroBatcher,
+        ModelRegistry,
+        ServeMetrics,
+    )
+
+    t_start = time.time()
+    actor = Actor(act_dim=ACT_DIM, hidden_sizes=HIDDEN)
+    params = actor.init(
+        jax.random.key(0), jnp.zeros((OBS_DIM,)), jax.random.key(1)
+    )
+    obs_spec = jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32)
+    obs = np.ones((OBS_DIM,), np.float32)
+    # Small per-forward capacity (2 rows x service_ms) so ONE replica
+    # saturates well below the client herd's closed-loop offer rate —
+    # otherwise a single replica absorbs the whole herd and scaling
+    # measures the clients, not the fleet.
+    max_batch = 2
+    service_s = service_ms / 1e3
+    out = {
+        "simulated_service_ms": service_ms,
+        "max_batch": max_batch,
+        "backend": jax.default_backend(),
+        "local_devices": len(jax.local_devices()),
+        "replicas": {},
+    }
+
+    def slow_engines(fleet):
+        """Pin each replica engine's forward to the simulated service
+        time (the sleep releases the GIL, so replicas overlap exactly
+        as N real devices would)."""
+        for rep in fleet._replicas:
+            engine, _, _ = rep.registry.acquire("default")
+            real_act = engine.act
+
+            def slow_act(*a, _real=real_act, **k):
+                time.sleep(service_s)
+                return _real(*a, **k)
+
+            engine.act = slow_act
+
+    def herd_window(act_fn, n_threads, window_s):
+        """Closed-loop saturation: goodput over a fixed window."""
+        stop = threading.Event()
+        done = [0] * n_threads
+        errors = []
+
+        def worker(i):
+            while not stop.is_set():
+                try:
+                    act_fn(obs)
+                    done[i] += 1
+                except Exception as e:  # noqa: BLE001 — recorded
+                    errors.append(repr(e)[:200])
+                    return
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(n_threads)
+        ]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        time.sleep(window_s)
+        stop.set()
+        for th in threads:
+            th.join(timeout=60.0)
+        return sum(done), time.perf_counter() - t0, errors
+
+    n_threads = 32
+    window_s = min(6.0, budget_s / 8)
+    goodput_by_n = {}
+    for n in replica_counts:
+        if n > len(jax.local_devices()):
+            out["replicas"][str(n)] = {
+                "skipped": f"only {len(jax.local_devices())} local devices"
+            }
+            continue
+        registry = ModelRegistry()
+        registry.register(
+            "default", actor, obs_spec, params=params,
+            max_batch=max_batch,
+        )
+        metrics = ServeMetrics()
+        with EngineFleet(
+            registry, devices=n, max_batch=max_batch, max_wait_ms=1.0,
+            metrics=metrics, capacity=1024,
+        ) as fleet:
+            fleet.warmup()
+            slow_engines(fleet)
+            fleet.act(obs, timeout=30.0)  # rinse
+            answered, elapsed, errors = herd_window(
+                lambda o: fleet.act(o, timeout=30.0), n_threads, window_s
+            )
+            snap = metrics.snapshot()
+            entry = {
+                "goodput_rps": round(answered / elapsed, 1),
+                "p50_ms": snap.get("p50_ms"),
+                "p99_ms": snap.get("p99_ms"),
+                "mean_batch_occupancy": snap.get("mean_batch_occupancy"),
+                "dispatch_share": [
+                    s["dispatched_total"] for s in fleet.replica_stats()
+                ],
+            }
+            if errors:
+                entry["errors"] = errors[:3]
+            goodput_by_n[n] = answered / elapsed
+            out["replicas"][str(n)] = entry
+            log(f"fleet x{n}: {entry['goodput_rps']} rps, "
+                f"p99 {entry['p99_ms']}ms, "
+                f"dispatch {entry['dispatch_share']}")
+        registry.close()
+    if 1 in goodput_by_n:
+        out["scaling_vs_1"] = {
+            str(n): round(goodput_by_n[n] / goodput_by_n[1], 2)
+            for n in goodput_by_n if n != 1
+        }
+
+    # Continuous vs group batching at LOW offered load (single
+    # replica): group mode holds a lone request max_wait_ms hoping for
+    # company; continuous dispatches it the moment the engine is free.
+    # The acceptance bar is continuous p50 <= group p50 here.
+    max_wait_ms = 10.0
+    paced_interval = 0.025  # ~40 rps offered, far below service rate
+    low_load = {}
+    for mode in ("group", "continuous"):
+        if time.time() - t_start > budget_s - 15:
+            break
+        registry = ModelRegistry()
+        registry.register(
+            "default", actor, obs_spec, params=params,
+            max_batch=max_batch,
+        )
+        metrics = ServeMetrics()
+        with MicroBatcher(
+            registry, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            metrics=metrics, mode=mode,
+        ) as mb:
+            engine, _, _ = registry.acquire("default")
+            real_act = engine.act
+
+            def slow_act(*a, _real=real_act, **k):
+                time.sleep(service_s)
+                return _real(*a, **k)
+
+            engine.act = slow_act
+            mb.act(obs, timeout=30.0)  # rinse
+            t_end = time.perf_counter() + min(4.0, budget_s / 10)
+            while time.perf_counter() < t_end:
+                mb.act(obs, timeout=30.0)
+                time.sleep(paced_interval)
+            low_load[mode] = metrics.snapshot().get("p50_ms")
+        registry.close()
+    out["low_load_p50_ms"] = dict(
+        low_load, max_wait_ms=max_wait_ms,
+        offered_rps=round(1.0 / paced_interval, 1),
+    )
+    if len(low_load) == 2:
+        log(f"fleet low-load p50: group {low_load['group']}ms vs "
+            f"continuous {low_load['continuous']}ms")
+    return out
+
+
 def bench_telemetry_overhead(budget_s=420.0):
     """Telemetry cost (docs/OBSERVABILITY.md zero-overhead contract):
     steady-state Trainer throughput with telemetry off vs on (full
@@ -1811,6 +1997,7 @@ _STAGES = {
     "visual": lambda: {"visual": bench_visual()},
     "serving": lambda: {"serving": bench_serving()},
     "overload": lambda: {"overload": bench_overload()},
+    "fleet": lambda: {"fleet": bench_fleet()},
     "host_envs": lambda: {"host_envs": bench_host_envs()},
     "telemetry_overhead": lambda: {
         "telemetry_overhead": bench_telemetry_overhead()
@@ -1834,14 +2021,14 @@ _STAGES = {
 def _run_stage_inprocess(name):
     """Child-process mode: run one stage, print one JSON line, exit 0."""
     if (
-        name == "sharding"
+        name in ("sharding", "fleet")
         and os.environ.get("TAC_BENCH_CHILD_PLATFORM") == "cpu"
         and "host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
     ):
-        # The mesh stage is meaningless on one device; on the CPU
-        # fallback give this child the same forced-device shim tier-1
-        # uses (must precede the first jax import, which happens in
-        # _ensure_platform below).
+        # The mesh and fleet stages are meaningless on one device; on
+        # the CPU fallback give this child the same forced-device shim
+        # tier-1 uses (must precede the first jax import, which
+        # happens in _ensure_platform below).
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=4"
@@ -2067,6 +2254,19 @@ def main():
     )
     if res and "error" in res:
         diagnostics.append({"overload_stage_error": res.pop("error")})
+    if res:
+        out.update(res)
+
+    # 5a'''. Fleet scale-out (docs/SERVING.md "Fleet"): aggregate
+    # goodput + p99 vs engine-replica count {1,2,4} through the real
+    # EngineFleet at a pinned simulated service time (the dispatch
+    # plane is what scales; on CPU the child gets the forced-device
+    # shim), plus continuous-vs-group batching p50 at low load.
+    res = run_stage_subprocess(
+        "fleet", 420, diagnostics, platform=serving_platform
+    )
+    if res and "error" in res:
+        diagnostics.append({"fleet_stage_error": res.pop("error")})
     if res:
         out.update(res)
 

@@ -15,9 +15,18 @@ Two ways to point it at a model:
         --act-limit 1.0
 
 Serving knobs: --port (0 = ephemeral, printed at startup), --max-batch,
---max-wait-ms (deadline before a partial batch flushes), --buckets
-(comma list overriding the power-of-two ladder), --poll-interval
-(checkpoint hot-reload cadence in seconds; 0 disables).
+--max-wait-ms (deadline before a partial batch flushes; group mode),
+--batch-mode (continuous = admit-into-next-dispatch, default; group =
+legacy boundary waiting), --buckets (comma list overriding the
+power-of-two ladder), --poll-interval (checkpoint hot-reload cadence
+in seconds; 0 disables), --devices (engine replicas in this process:
+one per local device behind least-loaded dispatch; 'all' or an int).
+
+Fleet mode (docs/SERVING.md "Fleet"): --fleet N spawns N worker
+processes on ephemeral ports and fronts them with the health-gated
+router on --port (membership ejection/re-admission, failover,
+rolling /reload, aggregated /metrics); --router-poll sets the
+membership poll cadence.
 
 Overload & degradation knobs (docs/SERVING.md): --queue-capacity
 (admission bound; past it /act answers 429 + Retry-After),
@@ -58,6 +67,27 @@ def parse_arguments(argv=None) -> argparse.Namespace:
     srv.add_argument("--port", type=int, default=8321)
     srv.add_argument("--max-batch", type=int, default=64)
     srv.add_argument("--max-wait-ms", type=float, default=2.0)
+    srv.add_argument("--batch-mode", choices=("continuous", "group"),
+                     default="continuous",
+                     help="Batch collection: 'continuous' dispatches "
+                          "whatever is queued the moment the engine "
+                          "frees up (deadline-priority order); "
+                          "'group' is the legacy boundary-waiting "
+                          "compat mode (docs/SERVING.md)")
+    srv.add_argument("--devices", default="1",
+                     help="Engine replicas in THIS process: an int or "
+                          "'all' for one replica per local device "
+                          "behind a shared admission layer + "
+                          "least-loaded dispatch (serve/fleet.py)")
+    flt = p.add_argument_group("fleet (multi-process)")
+    flt.add_argument("--fleet", type=int, default=0,
+                     help="Spawn N serve.py worker processes and front "
+                          "them with the health-gated fleet router on "
+                          "--port (serve/router.py; docs/SERVING.md "
+                          "'Fleet')")
+    flt.add_argument("--router-poll", type=float, default=1.0,
+                     help="Fleet membership /healthz poll interval "
+                          "seconds")
     srv.add_argument("--buckets", type=str, default=None,
                      help="Comma-separated bucket sizes (default: powers "
                           "of two up to max-batch)")
@@ -168,8 +198,145 @@ def _resolve_model(args):
     return actor_def, obs_spec, ckpt_dir
 
 
+def _worker_argv(argv):
+    """The child argv for one fleet worker: the parent's args minus the
+    fleet flags, with an ephemeral port (each worker prints its real
+    address on stdout; the parent reads it back)."""
+    import sys
+
+    src = list(sys.argv[1:] if argv is None else argv)
+    out, skip = [], False
+    for a in src:
+        if skip:
+            skip = False
+            continue
+        if a in ("--fleet", "--port", "--router-poll"):
+            skip = True
+            continue
+        if a.split("=", 1)[0] in ("--fleet", "--port", "--router-poll"):
+            continue
+        out.append(a)
+    return out + ["--port", "0"]
+
+
+def run_fleet(args, argv):
+    """``--fleet N``: spawn N workers, front them with the router.
+
+    Each worker is a full ``serve.py`` process (own engines, own
+    drain/breaker/reload machinery) on an ephemeral port; the router
+    owns membership and rolling reload (docs/SERVING.md "Fleet").
+    SIGTERM to THIS process rolls the whole fleet down gracefully:
+    workers get SIGTERM (their drain answers everything accepted),
+    then the router stops. A worker dying on its own is NOT fatal —
+    membership ejects it and the survivors keep serving."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import threading
+    import time
+
+    from torch_actor_critic_tpu.serve.router import FleetRouter
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    workers, pumps = [], []
+    for i in range(args.fleet):
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(here, "serve.py")]
+            + _worker_argv(argv),
+            stdout=subprocess.PIPE, stderr=None, text=True, cwd=here,
+        )
+        workers.append(proc)
+    addresses = []
+    for i, proc in enumerate(workers):
+        address, deadline = None, time.time() + 300
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                if proc.poll() is not None:
+                    raise SystemExit(
+                        f"fleet worker {i} exited rc={proc.returncode} "
+                        "before becoming ready"
+                    )
+                time.sleep(0.1)
+                continue
+            if line.startswith("{"):
+                try:
+                    address = json.loads(line)["serving"]
+                    break
+                except (json.JSONDecodeError, KeyError):
+                    continue
+        if address is None:
+            raise SystemExit(f"fleet worker {i} never printed its address")
+        addresses.append(address)
+
+        def _pump(stream=proc.stdout, idx=i):
+            for out_line in stream:
+                logger.debug("worker %d: %s", idx, out_line.rstrip())
+
+        th = threading.Thread(target=_pump, daemon=True)
+        th.start()
+        pumps.append(th)
+    logger.info("fleet up: %d workers %s", len(addresses), addresses)
+
+    span_log = None
+    if args.trace_export:
+        from torch_actor_critic_tpu.telemetry.traceview import RequestSpanLog
+
+        span_log = RequestSpanLog()
+    router = FleetRouter(
+        addresses, host=args.host, port=args.port,
+        poll_interval_s=args.router_poll,
+        request_timeout_s=args.request_timeout,
+        span_log=span_log,
+    )
+    router.poll_once()
+
+    def _teardown(signum=None, frame=None):
+        logger.info("fleet teardown: draining %d workers", len(workers))
+        for proc in workers:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in workers:
+            try:
+                proc.wait(timeout=args.drain_timeout + 30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        router._httpd.shutdown()
+
+    signal.signal(signal.SIGTERM, lambda s, f: threading.Thread(
+        target=_teardown, daemon=True).start())
+    print(json.dumps({
+        "router": router.address,
+        "workers": dict(zip(
+            (f"w{i}" for i in range(len(addresses))), addresses
+        )),
+        "pids": [proc.pid for proc in workers],
+    }), flush=True)
+    try:
+        router.serve_forever()
+    finally:
+        _teardown()
+        if args.trace_export and span_log is not None:
+            from torch_actor_critic_tpu.telemetry.traceview import (
+                export_trace,
+                router_hop_events,
+            )
+
+            summary = export_trace(
+                args.trace_export, router_hop_events(span_log.records())
+            )
+            logger.info(
+                "router trace exported to %s (%d hop spans)",
+                summary["path"], summary["router_spans"],
+            )
+
+
 def main(argv=None):
     args = parse_arguments(argv)
+    if args.fleet and args.fleet > 0:
+        run_fleet(args, argv)
+        return
     from torch_actor_critic_tpu.utils.platform import honor_platform_env
 
     honor_platform_env()
@@ -208,6 +375,12 @@ def main(argv=None):
 
         span_log = RequestSpanLog()
 
+    if args.devices == "all":
+        import jax
+
+        devices = len(jax.local_devices())
+    else:
+        devices = int(args.devices)
     server = PolicyServer(
         registry, host=args.host, port=args.port,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
@@ -216,6 +389,8 @@ def main(argv=None):
         act_timeout_s=args.act_timeout,
         capacity=args.queue_capacity,
         span_log=span_log,
+        mode=args.batch_mode,
+        devices=devices if devices > 1 else None,
     )
     # Rolling-restart contract: SIGTERM stops admissions, answers every
     # accepted request, then serve_forever returns and we exit 0.

@@ -1,0 +1,726 @@
+"""Fleet-scale serving tests (docs/SERVING.md "Fleet"): engine-per-
+device replication with least-loaded + health-gated dispatch,
+continuous batching vs the group compat mode, the multi-process router
+(membership, failover, rolling reload), and fleet /metrics
+aggregation.
+
+Determinism rules as in tests/test_overload.py: engine stalls are real
+Events the test controls, breaker time is an injected fake clock, and
+routing decisions are observed through counters, not timing. Replicas
+land on distinct forced-CPU devices (conftest's 8-device shim), so the
+per-device placement path is the real one.
+"""
+
+import json
+import threading
+import time
+from urllib import request as urlreq
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_actor_critic_tpu.models import Actor, DoubleCritic
+from torch_actor_critic_tpu.sac import SAC
+from torch_actor_critic_tpu.serve import (
+    BreakerOpenError,
+    CircuitBreaker,
+    EngineFleet,
+    FleetRouter,
+    MicroBatcher,
+    ModelRegistry,
+    PolicyClient,
+    PolicyServer,
+    ServeMetrics,
+    ShedError,
+    aggregate_snapshots,
+)
+from torch_actor_critic_tpu.telemetry.histogram import FixedBucketHistogram
+from torch_actor_critic_tpu.telemetry.traceview import (
+    RequestSpanLog,
+    router_hop_events,
+)
+from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
+from torch_actor_critic_tpu.utils.config import SACConfig
+
+OBS_DIM, ACT_DIM = 17, 6
+OBS = np.ones((OBS_DIM,), np.float32)
+
+
+def make_actor_and_params(seed=0):
+    actor = Actor(act_dim=ACT_DIM, hidden_sizes=(32, 32))
+    params = actor.init(
+        jax.random.key(seed), jnp.zeros((OBS_DIM,)), jax.random.key(1)
+    )
+    return actor, params
+
+
+def flat_spec():
+    return jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32)
+
+
+def make_registry(breaker=None):
+    actor, params = make_actor_and_params()
+    reg = ModelRegistry()
+    reg.register(
+        "default", actor, flat_spec(), params=params, max_batch=4,
+        warmup=False, breaker=breaker,
+    )
+    return reg, actor, params
+
+
+def stall_replica(fleet, index, slot="default"):
+    """Replace one replica's engine.act with an Event-gated version;
+    returns (release_event, calls_list)."""
+    engine, _, _ = fleet._replicas[index].registry.acquire(slot)
+    release = threading.Event()
+    calls = []
+    real_act = engine.act
+
+    def stalled(*args, **kwargs):
+        calls.append(kwargs.get("deterministic", True))
+        release.wait(30.0)
+        return real_act(*args, **kwargs)
+
+    engine.act = stalled
+    return release, calls
+
+
+def wait_until(pred, timeout=30.0, msg="condition never held"):
+    deadline = time.time() + timeout
+    while not pred():
+        assert time.time() < deadline, msg
+        time.sleep(0.002)
+
+
+# ------------------------------------------------- engine-per-device fleet
+
+
+class _FakeLoadBatcher:
+    """Routing-policy stand-in: controlled load/EMA, records submits."""
+
+    def __init__(self, load=0, ema=None):
+        self._load = load
+        self._ema = ema
+        self.submits = 0
+        self.mode = "continuous"
+
+    def load_rows(self):
+        return self._load
+
+    @property
+    def ema_row_s(self):
+        return self._ema
+
+    def queue_depth(self):
+        return 0
+
+    def submit(self, *a, **k):
+        from concurrent.futures import Future
+
+        self.submits += 1
+        f = Future()
+        f.set_result(None)
+        return f
+
+    def close(self, timeout=10.0):
+        pass
+
+
+def _fake_fleet(loads_emas):
+    """EngineFleet with the real routing logic over fake batchers."""
+    reg, _, _ = make_registry()
+    fleet = EngineFleet(
+        reg, devices=jax.local_devices()[:len(loads_emas)], max_batch=4,
+    )
+    fakes = []
+    for rep, (load, ema) in zip(fleet._replicas, loads_emas):
+        rep.batcher.close()
+        rep.batcher = _FakeLoadBatcher(load, ema)
+        fakes.append(rep.batcher)
+    return reg, fleet, fakes
+
+
+def test_least_loaded_scoring_is_load_times_ema():
+    """The dispatcher minimizes estimated seconds-to-clear = load_rows
+    x seconds-per-row EMA — depth alone is NOT the signal: a deep
+    queue on a fast replica beats a shallow one on a slow replica."""
+    reg, fleet, fakes = _fake_fleet(
+        [(8, 0.001), (2, 0.1)]  # r0: 8ms to clear; r1: 200ms
+    )
+    try:
+        for _ in range(3):
+            fleet.submit(OBS)
+        assert fakes[0].submits == 3  # fast replica wins despite depth
+        assert fakes[1].submits == 0
+    finally:
+        fleet.close()
+        reg.close()
+
+
+def test_least_loaded_unmeasured_backlog_yields_and_idle_ties_spread():
+    """An unmeasured replica WITH backlog (its first group never came
+    back) is scored pessimistically and yields; an idle fleet spreads
+    round-robin (all scores 0)."""
+    reg, fleet, fakes = _fake_fleet([(1, None), (3, 0.001)])
+    try:
+        fleet.submit(OBS)
+        assert fakes[1].submits == 1  # 3 rows x 1ms << 1 row x default
+    finally:
+        fleet.close()
+        reg.close()
+    reg2, fleet2, fakes2 = _fake_fleet([(0, None), (0, None), (0, None)])
+    try:
+        for _ in range(6):
+            fleet2.submit(OBS)
+        assert [f.submits for f in fakes2] == [2, 2, 2]  # round-robin
+    finally:
+        fleet2.close()
+        reg2.close()
+
+
+def test_stalled_replica_traffic_flows_to_free_replica():
+    """End-to-end: with replica 0 wedged inside its engine (in-flight
+    rows held, service rate unmeasured), subsequent requests are
+    served by replica 1 while the wedge holds."""
+    reg, _, _ = make_registry()
+    with EngineFleet(
+        reg, devices=jax.local_devices()[:2], max_batch=4, capacity=64,
+    ) as fleet:
+        release, _ = stall_replica(fleet, 0)
+        try:
+            # Round-robin from an idle fleet: the first request lands
+            # on replica 0 and wedges there.
+            blocked = fleet.submit(OBS)
+            assert fleet._replicas[0].dispatched == 1
+            wait_until(
+                lambda: fleet._replicas[0].batcher.load_rows() == 1
+                and fleet._replicas[0].batcher.queue_depth() == 0,
+                msg="replica 0 never collected its request",
+            )
+            # Sequential blocking acts: replica 0 scores 1 row x the
+            # pessimistic unmeasured rate; replica 1 is idle (score 0)
+            # at each submit, so every act MUST route to replica 1.
+            for _ in range(5):
+                assert fleet.act(
+                    OBS, timeout=30.0
+                ).action.shape == (ACT_DIM,)
+            assert fleet._replicas[0].dispatched == 1
+            assert fleet._replicas[1].dispatched == 5
+            assert fleet._replicas[1].batcher.ema_row_s is not None
+            release.set()
+            assert blocked.result(timeout=30.0).action.shape == (ACT_DIM,)
+        finally:
+            release.set()
+    reg.close()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_breaker_open_replica_ejected_then_readmitted():
+    """A replica whose breaker trips leaves the rotation (health gate);
+    traffic continues on the others; after cooldown the half-open
+    probe re-admits it. Every replica open => fleet-level 503."""
+    clock = FakeClock()
+    base_breaker = CircuitBreaker(
+        fail_threshold=1, cooldown_s=10.0, clock=clock
+    )
+    reg, _, _ = make_registry(breaker=base_breaker)
+    with EngineFleet(
+        reg, devices=jax.local_devices()[:2], max_batch=4, capacity=64,
+    ) as fleet:
+        # Replica breakers inherit thresholds + the fake clock.
+        br0 = fleet._replicas[0].registry.breaker("default")
+        br1 = fleet._replicas[1].registry.breaker("default")
+        assert br0.fail_threshold == 1 and br0._clock is clock
+
+        br0.record_failure(RuntimeError("injected device fault"))
+        assert br0.state == "open"
+        futures = [fleet.submit(OBS) for _ in range(4)]
+        assert fleet._replicas[0].dispatched == 0  # ejected
+        assert fleet._replicas[1].dispatched == 4
+        for f in futures:
+            assert f.result(timeout=30.0).action.shape == (ACT_DIM,)
+
+        # Whole fleet tripped: structured fleet-level shed.
+        br1.record_failure(RuntimeError("injected device fault"))
+        with pytest.raises(BreakerOpenError) as e:
+            fleet.submit(OBS)
+        assert e.value.reason == "breaker_open"
+        assert fleet.metrics.snapshot()["shed_by_reason"]["breaker_open"] == 1
+
+        # Cooldown -> half-open admits; healthy forwards close both.
+        clock.advance(10.0)
+        assert fleet.act(OBS, timeout=30.0).action.shape == (ACT_DIM,)
+        assert fleet.act(OBS, timeout=30.0).action.shape == (ACT_DIM,)
+        wait_until(
+            lambda: br0.state == "closed" and br1.state == "closed",
+            msg="probes never closed the replica breakers",
+        )
+        # replica breaker events landed in the shared registry log,
+        # tagged with the replica index
+        evs = [e for e in reg.breaker_events() if "replica" in e]
+        assert any(e["event"] == "breaker_open" for e in evs)
+    reg.close()
+
+
+def test_fleet_shared_admission_bound_and_generation_propagation():
+    """The capacity bound applies to the SUM of replica queues, and a
+    hot-reload swap in the shared registry reaches every replica via
+    generation-keyed placement."""
+    reg, actor, params = make_registry()
+    with EngineFleet(
+        reg, devices=jax.local_devices()[:2], max_batch=4, capacity=4,
+    ) as fleet:
+        rel0, _ = stall_replica(fleet, 0)
+        rel1, _ = stall_replica(fleet, 1)
+        try:
+            blockers = [fleet.submit(OBS) for _ in range(2)]
+            wait_until(lambda: fleet.queue_depth() == 0)
+            queued = [fleet.submit(OBS) for _ in range(4)]  # at bound
+            with pytest.raises(ShedError) as e:
+                fleet.submit(OBS)
+            assert e.value.reason == "queue_full"
+            assert e.value.detail["capacity"] == 4
+            rel0.set()
+            rel1.set()
+            for f in blockers + queued:
+                assert f.result(timeout=30.0).generation == 0
+        finally:
+            rel0.set()
+            rel1.set()
+        # swap propagates: both replicas serve the new generation
+        gen = reg.swap("default", params)
+        assert gen == 1
+        for _ in range(2):  # round-robin covers both replicas
+            assert fleet.act(OBS, timeout=30.0).generation == 1
+    reg.close()
+
+
+# ----------------------------------------------------- continuous batching
+
+
+def test_continuous_admit_mid_formation_bitwise_matches_group_mode():
+    """The same request mix answered in continuous and group modes is
+    bitwise identical (engine row-wise invariance makes grouping
+    invisible), including requests admitted while a group was already
+    forming behind a stalled engine."""
+    reg, _, _ = make_registry()
+    rng = np.random.default_rng(3)
+    singles = rng.standard_normal((6, OBS_DIM)).astype(np.float32)
+    batch = rng.standard_normal((3, OBS_DIM)).astype(np.float32)
+
+    results = {}
+    for mode in ("group", "continuous"):
+        with MicroBatcher(
+            reg, max_batch=4, max_wait_ms=1.0, mode=mode,
+            metrics=ServeMetrics(),
+        ) as mb:
+            engine, _, _ = reg.acquire("default")
+            release = threading.Event()
+            real_act = engine.act
+
+            def stalled(*args, **kwargs):
+                release.wait(30.0)
+                return real_act(*args, **kwargs)
+
+            engine.act = stalled
+            try:
+                futures = [mb.submit(singles[0])]
+                wait_until(lambda: mb.queue_depth() == 0)
+                # admitted mid-formation, while the engine is busy
+                futures += [mb.submit(o) for o in singles[1:]]
+                futures.append(mb.submit(batch))
+                release.set()
+                results[mode] = [
+                    np.asarray(f.result(timeout=30.0).action)
+                    for f in futures
+                ]
+            finally:
+                release.set()
+                engine.act = real_act
+    assert len(results["group"]) == len(results["continuous"]) == 7
+    for g, c in zip(results["group"], results["continuous"]):
+        np.testing.assert_array_equal(g, c)
+
+
+def test_continuous_deadline_priority_preempts_batch_filling():
+    """With requests of two classes queued behind a busy engine, the
+    continuous collector serves the class holding the nearest-deadline
+    request first — deadline metadata preempts FIFO."""
+    reg, _, _ = make_registry()
+    with MicroBatcher(
+        reg, max_batch=4, max_wait_ms=50.0, mode="continuous",
+        metrics=ServeMetrics(), seed=7,
+    ) as mb:
+        engine, _, _ = reg.acquire("default")
+        release = threading.Event()
+        order = []
+        real_act = engine.act
+
+        def logged(*args, **kwargs):
+            order.append(bool(kwargs.get("deterministic", True)))
+            release.wait(30.0)
+            return real_act(*args, **kwargs)
+
+        engine.act = logged
+        try:
+            blocker = mb.submit(OBS, deterministic=True)
+            wait_until(lambda: len(order) == 1)
+            # FIFO would serve the deadline-free deterministic request
+            # next; priority must pick the sampled class (deadline).
+            free = mb.submit(OBS, deterministic=True)
+            urgent = mb.submit(OBS, deterministic=False, deadline_s=20.0)
+            release.set()
+            for f in (blocker, free, urgent):
+                assert f.result(timeout=30.0).action.shape == (ACT_DIM,)
+            assert order[1] is False, (
+                f"deadline-carrying class was not served first: {order}"
+            )
+        finally:
+            release.set()
+            engine.act = real_act
+    reg.close()
+
+
+def test_continuous_mode_is_server_default_and_group_pinned():
+    """PolicyServer defaults to continuous; group mode stays available
+    as the pinned compat path."""
+    reg, _, _ = make_registry()
+    with PolicyServer(reg, port=0, max_batch=4) as srv:
+        assert srv.batcher.mode == "continuous"
+    reg2, _, _ = make_registry()
+    with PolicyServer(reg2, port=0, max_batch=4, mode="group") as srv:
+        assert srv.batcher.mode == "group"
+        srv.start()
+        assert srv.client.act(OBS).action.shape == (ACT_DIM,)
+    with pytest.raises(ValueError, match="mode"):
+        MicroBatcher(reg2, max_batch=4, mode="rolling")
+
+
+# ------------------------------------------------------------ fleet router
+
+
+def _save_checkpoint(ckpt_dir, epoch, seed):
+    cfg = SACConfig(hidden_sizes=(32, 32))
+    sac = SAC(
+        cfg,
+        Actor(act_dim=ACT_DIM, hidden_sizes=(32, 32)),
+        DoubleCritic(hidden_sizes=(32, 32)),
+        ACT_DIM,
+    )
+    state = sac.init_state(jax.random.key(seed), jnp.zeros((OBS_DIM,)))
+    ck = Checkpointer(ckpt_dir, save_buffer=False)
+    try:
+        ck.save(epoch, state, extra={"config": cfg.to_json()}, wait=True)
+    finally:
+        ck.close()
+    return state.actor_params
+
+
+def _worker(params=None, ckpt_dir=None, span_log=None):
+    actor = Actor(act_dim=ACT_DIM, hidden_sizes=(32, 32))
+    reg = ModelRegistry()
+    reg.register(
+        "default", actor, flat_spec(), params=params,
+        ckpt_dir=ckpt_dir, max_batch=4, warmup=False,
+    )
+    srv = PolicyServer(
+        reg, port=0, max_batch=4, max_wait_ms=1.0, span_log=span_log,
+    )
+    srv.start()
+    return srv
+
+
+def test_router_routes_ejects_killed_worker_and_failover_zero_drops():
+    """Kill a worker mid-rotation: the in-flight proxy attempt fails
+    over to a healthy worker (the client sees a normal 200), the dead
+    worker is ejected on the spot, and /healthz reflects it."""
+    _, params = make_actor_and_params()
+    w0, w1 = _worker(params=params), _worker(params=params)
+    router = FleetRouter(
+        [w0.address, w1.address], poll_interval_s=30.0,  # manual polls
+    )
+    router.poll_once()
+    router.start()
+    try:
+        client = PolicyClient(url=router.address, retries=2)
+        for _ in range(4):
+            assert client.act(OBS, timeout=30.0).action.shape == (ACT_DIM,)
+        view = router.membership()
+        assert view["admitted_workers"] == 2
+        assert {w["routed_total"] for w in view["workers"].values()} == {2}
+
+        w0.close()  # the kill: connection refused from here on
+        for _ in range(4):  # every request still answered
+            assert client.act(OBS, timeout=30.0).action.shape == (ACT_DIM,)
+        view = router.membership()
+        assert view["workers"]["w0"]["admitted"] is False
+        assert view["workers"]["w0"]["reason"] == "unreachable"
+        assert router.failovers_total >= 1
+
+        # router /healthz still 200 with one admitted worker
+        health = json.loads(
+            urlreq.urlopen(router.address + "/healthz", timeout=30).read()
+        )
+        assert health["status"] == "ok"
+        assert health["admitted_workers"] == 1
+    finally:
+        router.close()
+        w1.close()
+
+
+def test_router_hop_tags_stitch_router_and_worker_spans():
+    """The router appends a `>worker` hop tag to X-Request-Id; the
+    worker records the tagged id in its span log and echoes it, so
+    router hop spans and worker request spans share the base id."""
+    _, params = make_actor_and_params()
+    worker_log = RequestSpanLog()
+    w0 = _worker(params=params, span_log=worker_log)
+    router_log = RequestSpanLog()
+    router = FleetRouter(
+        [w0.address], poll_interval_s=30.0, span_log=router_log,
+    )
+    router.poll_once()
+    router.start()
+    try:
+        req = urlreq.Request(
+            router.address + "/act",
+            data=json.dumps({"obs": OBS.tolist()}).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "X-Request-Id": "trace-me",
+            },
+        )
+        with urlreq.urlopen(req, timeout=30) as resp:
+            assert resp.headers["X-Request-Id"] == "trace-me>w0"
+            body = json.loads(resp.read())
+        assert len(body["action"]) == ACT_DIM
+        # router side: base id + worker attribution
+        recs = router_log.records()
+        assert recs and recs[-1]["request_id"] == "trace-me"
+        assert recs[-1]["worker"] == "w0"
+        assert recs[-1]["outcome"] == "ok"
+        # worker side: the hop-tagged id went through the batcher
+        wait_until(lambda: len(worker_log) >= 1)
+        wrecs = worker_log.records()
+        assert wrecs[-1]["request_id"] == "trace-me>w0"
+        # Perfetto events: one B/E pair on the router pid
+        events = router_hop_events(recs)
+        assert [e["ph"] for e in events] == ["B", "E"]
+        assert events[0]["name"] == "hop w0"
+        assert events[0]["args"]["request_id"] == "trace-me"
+    finally:
+        router.close()
+        w0.close()
+
+
+def test_rolling_reload_zero_dropped_requests(tmp_path):
+    """Rolling reload across a 2-worker fleet under concurrent load:
+    one worker at a time is ejected, hot-reloaded (validated), and
+    re-admitted — every client request during the roll is answered
+    and both workers end on the new epoch."""
+    dirs = [tmp_path / "a", tmp_path / "b"]
+    for i, d in enumerate(dirs):
+        _save_checkpoint(d, 0, seed=i)
+    workers = [_worker(ckpt_dir=str(d)) for d in dirs]
+    router = FleetRouter(
+        [w.address for w in workers], poll_interval_s=30.0,
+    )
+    router.poll_once()
+    router.start()
+    errors, answered = [], [0]
+    stop = threading.Event()
+
+    def load_loop():
+        client = PolicyClient(url=router.address, retries=3)
+        while not stop.is_set():
+            try:
+                res = client.act(OBS, timeout=30.0)
+                assert res.action.shape == (ACT_DIM,)
+                answered[0] += 1
+            except Exception as e:  # noqa: BLE001 — recorded, asserted
+                errors.append(repr(e))
+    try:
+        # the trainer "writes" a newer epoch to both workers' dirs
+        for i, d in enumerate(dirs):
+            _save_checkpoint(d, 1, seed=10 + i)
+        herd = [threading.Thread(target=load_loop) for _ in range(3)]
+        for th in herd:
+            th.start()
+        wait_until(lambda: answered[0] >= 3)  # load is flowing
+        out = router.rolling_reload(settle_timeout_s=30.0)
+        stop.set()
+        for th in herd:
+            th.join(timeout=30.0)
+        assert set(out) == {"w0", "w1"}
+        for name, status in out.items():
+            assert status["readmitted"] is True, (name, status)
+            assert status["reload"]["default"]["status"] == "ok", status
+            assert status["reload"]["default"]["epoch"] == 1
+        assert errors == [], errors[:3]
+        assert answered[0] >= 3
+        view = router.membership()
+        assert view["admitted_workers"] == 2
+        for w in workers:  # both serve generation 1 now
+            health = json.loads(
+                urlreq.urlopen(w.address + "/healthz", timeout=30).read()
+            )
+            assert health["slots"]["default"]["generation"] == 1
+            assert health["slots"]["default"]["epoch"] == 1
+    finally:
+        stop.set()
+        router.close()
+        for w in workers:
+            w.close()
+
+
+# ------------------------------------------------------- /metrics merging
+
+
+def test_aggregate_snapshots_matches_single_process_reference():
+    """Fleet histogram merge == the histogram one process would have
+    built from all samples: identical counts and percentiles. Counters
+    sum; per-worker labels carry each worker's own rate and sheds."""
+    rng = np.random.default_rng(0)
+    lat_a = rng.uniform(0.5, 20.0, size=400)
+    lat_b = rng.uniform(5.0, 300.0, size=300)
+    ma, mb_, ref = ServeMetrics(), ServeMetrics(), FixedBucketHistogram()
+    for v in lat_a:
+        ma.record_done(float(v))
+        ref.record(float(v))
+    for v in lat_b:
+        mb_.record_done(float(v))
+        ref.record(float(v))
+    ma.record_shed("queue_full")
+    mb_.record_shed("queue_full")
+    mb_.record_shed("breaker_open")
+    snap_a, snap_b = ma.snapshot(), mb_.snapshot()
+    agg = aggregate_snapshots({"w0": snap_a, "w1": snap_b, "w2": None})
+
+    assert agg["responses_total"] == 700
+    assert agg["sheds_total"] == 3
+    assert agg["shed_by_reason"] == {"queue_full": 2, "breaker_open": 1}
+    assert agg["workers_reporting"] == 2
+    assert agg["workers"]["w2"] == {"unreachable": True}
+    # per-worker labels: each worker's own counters survive unfolded
+    assert agg["workers"]["w0"]["responses_total"] == 400
+    assert agg["workers"]["w1"]["responses_total"] == 300
+    assert agg["workers"]["w1"]["shed_by_reason"]["breaker_open"] == 1
+    # merged histogram == single-process reference, bit for bit
+    assert agg["latency_hist"]["counts"] == ref.raw_counts()["counts"]
+    p50, p95, p99 = ref.percentiles((50, 95, 99))
+    assert agg["p50_ms"] == round(p50, 3)
+    assert agg["p95_ms"] == round(p95, 3)
+    assert agg["p99_ms"] == round(p99, 3)
+    assert agg["mean_ms"] == round(ref.mean, 3)
+    assert agg["max_ms"] == round(ref.max, 3)
+    # rates of disjoint streams add
+    assert agg["requests_per_sec"] == round(
+        snap_a["requests_per_sec"] + snap_b["requests_per_sec"], 2
+    )
+
+
+def test_aggregate_snapshots_restart_never_double_counts():
+    """A worker that restarted reports reset counters; summing live
+    values keeps the fleet total equal to what the processes hold."""
+    m = ServeMetrics()
+    for _ in range(5):
+        m.record_done(1.0)
+    before = aggregate_snapshots({"w0": m.snapshot()})
+    assert before["responses_total"] == 5
+    fresh = ServeMetrics()  # the restart
+    fresh.record_done(1.0)
+    after = aggregate_snapshots({"w0": fresh.snapshot()})
+    assert after["responses_total"] == 1  # not 6: no double count
+    assert after["workers"]["w0"]["responses_total"] == 1
+
+
+def test_histogram_merge_raw_validates_spec():
+    h = FixedBucketHistogram()
+    other = FixedBucketHistogram(lo=1.0, hi=10.0, growth=2.0)
+    with pytest.raises(ValueError, match="spec mismatch"):
+        h.merge_raw(other.raw_counts())
+
+
+# ------------------------------------------------------- HTTP client retry
+
+
+def test_http_client_retries_honor_retry_after_with_jitter():
+    """The HTTP PolicyClient backs off per the server's Retry-After
+    (plus jitter), retries within its budget, and succeeds once the
+    server recovers."""
+    _, params = make_actor_and_params()
+    w = _worker(params=params)
+    sleeps = []
+
+    class SeqRandom:
+        def random(self):
+            return 1.0  # deterministic max jitter: delay = 1.25 * base
+
+    try:
+        w.drain(flush_timeout_s=5.0)  # worker now sheds 503 draining
+
+        client = PolicyClient(
+            url=w.address, retries=2, backoff_s=0.05,
+            sleep=sleeps.append, rng=SeqRandom(),
+        )
+        with pytest.raises(ShedError) as e:
+            client.act(OBS, timeout=30.0)
+        assert e.value.reason == "draining"
+        # two retries, both honoring the server's Retry-After: 1s
+        # (> the exponential base), times the 1.25 jitter factor
+        assert sleeps == [1.25, 1.25]
+        assert client.retries_total == 2
+    finally:
+        w.close()
+
+
+def test_http_client_never_retries_past_deadline():
+    """Deadline-aware: when Retry-After cannot fit inside the caller's
+    remaining budget, the client raises immediately instead of
+    sleeping through the deadline."""
+    _, params = make_actor_and_params()
+    w = _worker(params=params)
+    sleeps = []
+    try:
+        # 4xx is never retried (checked pre-drain: draining answers
+        # 503 for every POST /act regardless of slot)
+        client2 = PolicyClient(url=w.address, retries=3)
+        with pytest.raises(RuntimeError, match="HTTP 404"):
+            client2.act(OBS, slot="nope", timeout=5.0)
+
+        w.drain(flush_timeout_s=5.0)
+        client = PolicyClient(
+            url=w.address, retries=5, backoff_s=0.05, sleep=sleeps.append,
+        )
+        t0 = time.perf_counter()
+        with pytest.raises(ShedError) as e:
+            client.act(OBS, timeout=0.5)  # Retry-After=1 cannot fit
+        assert e.value.reason == "draining"
+        assert sleeps == []  # no blind sleep into the deadline
+        assert time.perf_counter() - t0 < 5.0
+    finally:
+        w.close()
+
+
+def test_http_client_requires_exactly_one_mode():
+    reg, _, _ = make_registry()
+    with pytest.raises(ValueError, match="either"):
+        PolicyClient()
+    with MicroBatcher(reg, max_batch=4) as mb:
+        with pytest.raises(ValueError, match="either"):
+            PolicyClient(reg, mb, url="http://x")
+        with pytest.raises(RuntimeError, match="in-process"):
+            PolicyClient(url="http://127.0.0.1:1").act_async(OBS)
+    reg.close()

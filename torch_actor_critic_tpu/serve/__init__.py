@@ -24,6 +24,18 @@ forward pass, and the TorchBeast server-side dynamic-batching pattern
   :class:`~torch_actor_critic_tpu.serve.server.PolicyClient`.
 - :mod:`~torch_actor_critic_tpu.serve.metrics` — queue depth, batch
   occupancy, request rate, latency percentiles and shed accounting.
+- :mod:`~torch_actor_critic_tpu.serve.fleet` — engine-per-device
+  replication (docs/SERVING.md "Fleet"): one engine replica +
+  dispatcher per local device behind a shared admission layer, routed
+  least-loaded (``load_rows × seconds-per-row EMA``) and health-gated
+  on per-replica breakers; hot-reload propagates by generation-keyed
+  params placement.
+- :mod:`~torch_actor_critic_tpu.serve.router` — the multi-process
+  fleet router (``serve.py --fleet N``): health-gated membership over
+  N workers (eject draining/breaker-open/unreachable, re-admit on
+  recovery), connection-failure failover, hop-tagged
+  ``X-Request-Id``, rolling hot-reload, and fleet-aggregated
+  ``/metrics`` (histogram merge).
 - :mod:`~torch_actor_critic_tpu.serve.admission` /
   :mod:`~torch_actor_critic_tpu.serve.breaker` — overload containment
   (docs/SERVING.md "Overload & degradation"): bounded-queue admission
@@ -44,8 +56,13 @@ from torch_actor_critic_tpu.serve.admission import (  # noqa: F401
 from torch_actor_critic_tpu.serve.batcher import MicroBatcher  # noqa: F401
 from torch_actor_critic_tpu.serve.breaker import CircuitBreaker  # noqa: F401
 from torch_actor_critic_tpu.serve.engine import PolicyEngine  # noqa: F401
-from torch_actor_critic_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from torch_actor_critic_tpu.serve.fleet import EngineFleet  # noqa: F401
+from torch_actor_critic_tpu.serve.metrics import (  # noqa: F401
+    ServeMetrics,
+    aggregate_snapshots,
+)
 from torch_actor_critic_tpu.serve.registry import ModelRegistry  # noqa: F401
+from torch_actor_critic_tpu.serve.router import FleetRouter  # noqa: F401
 from torch_actor_critic_tpu.serve.server import (  # noqa: F401
     PolicyClient,
     PolicyServer,

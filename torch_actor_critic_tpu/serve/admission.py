@@ -21,6 +21,15 @@ docs/SERVING.md "Overload & degradation"):
   work: HTTP 503 (a load balancer should route elsewhere).
 - ``breaker_open`` (:class:`BreakerOpenError`) — the slot's engine is
   tripped (:mod:`~torch_actor_critic_tpu.serve.breaker`): HTTP 503.
+  From an :class:`~torch_actor_critic_tpu.serve.fleet.EngineFleet`
+  this means EVERY replica's breaker refused — one tripped replica is
+  silently routed around.
+
+The fleet router (:mod:`~torch_actor_critic_tpu.serve.router`) adds
+two reasons of its own on the wire, both 503 + ``Retry-After``:
+``no_workers`` (every worker ejected from membership) and
+``worker_unreachable`` (the last proxy attempt died at the connection
+level after failover exhausted the admitted set).
 
 :class:`NonFiniteActionError` is the engine-side fault the breaker
 counts: the jitted forward's own fused all-finite reduction (the PR 2

@@ -21,6 +21,30 @@ Grouping rules:
   including ones drained during shutdown — gets its future resolved:
   nothing is dropped.
 
+Two collection **modes** (docs/SERVING.md "Continuous batching"):
+
+- ``"continuous"`` (default) — admit-into-next-dispatch: whenever the
+  engine is free, everything queued is dispatched immediately, up to
+  the bucket ladder's top. The *forward itself* is the batching
+  window: rows arriving while the engine runs the previous group form
+  the next one, so sustained load still fills buckets while a lone
+  request at low load pays zero coalescing wait (p50 drops by
+  ``max_wait_ms``). Selection is priority-ordered off the PR-5
+  deadline metadata — the request nearest its deadline picks the
+  ``(slot, deterministic)`` class and orders the group, so
+  near-deadline rows preempt batch-filling instead of aging out
+  behind deadline-free traffic.
+- ``"group"`` — the original boundary-waiting semantics, kept as a
+  compat mode and pinned by tests: the dispatcher holds the forming
+  group up to ``max_wait_ms`` past the oldest request hoping to fill
+  ``max_batch`` rows, strict FIFO within a class.
+
+Responses are **bitwise identical across modes** for deterministic
+requests: grouping only changes which padded forward a row rides in,
+and the engine's row-wise/batch-shape-invariance guarantee
+(:mod:`~torch_actor_critic_tpu.serve.engine`) makes that invisible
+(pinned by tests/test_fleet.py).
+
 Each response carries the model **generation** it was computed under
 (:mod:`~torch_actor_critic_tpu.serve.registry`): the dispatcher
 captures ``(engine, params, generation)`` once per group, so a
@@ -105,9 +129,11 @@ class MicroBatcher:
     (:class:`~torch_actor_critic_tpu.serve.registry.ModelRegistry`).
     ``max_batch`` bounds rows per engine call; ``max_wait_ms`` bounds
     the queueing latency added to the OLDEST request in a group (a lone
-    request never waits longer than the deadline). ``seed`` keys the
-    sampled-action PRNG stream. ``capacity`` bounds the number of
-    QUEUED requests — the overload backstop: submit past it raises
+    request never waits longer than the deadline) — ``"group"`` mode
+    only; ``"continuous"`` mode (the default, see the module docstring)
+    never waits on a non-empty queue. ``seed`` keys the sampled-action
+    PRNG stream. ``capacity`` bounds the number of QUEUED requests —
+    the overload backstop: submit past it raises
     :class:`~torch_actor_critic_tpu.serve.admission.ShedError`
     (``queue_full``) instead of growing host memory without bound.
     """
@@ -121,15 +147,21 @@ class MicroBatcher:
         seed: int = 0,
         capacity: int = 1024,
         span_log=None,
+        mode: str = "continuous",
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if mode not in ("continuous", "group"):
+            raise ValueError(
+                f"mode must be 'continuous' or 'group', got {mode!r}"
+            )
         self.registry = registry
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.capacity = int(capacity)
+        self.mode = mode
         self.metrics = metrics if metrics is not None else ServeMetrics()
         # Optional per-request span recording
         # (telemetry.traceview.RequestSpanLog) for the cross-plane
@@ -146,6 +178,11 @@ class MicroBatcher:
         # lock by submit-time deadline-feasibility checks.
         self._ema_row_s: float | None = None
         self._ema_samples = 0
+        # Rows popped off the queue but not yet resolved (the group
+        # currently inside the engine). The fleet's least-loaded
+        # dispatcher reads load_rows() = queued + in-flight: a replica
+        # mid-forward with an empty queue is NOT idle.
+        self._inflight_rows = 0
         self._running = True
         self._thread = threading.Thread(
             target=self._dispatch_loop, name="micro-batcher", daemon=True
@@ -314,7 +351,11 @@ class MicroBatcher:
             if group is None:
                 return
             if group:  # may be empty when every queued request expired
-                self._run_group(group)
+                try:
+                    self._run_group(group)
+                finally:
+                    with self._lock:
+                        self._inflight_rows -= sum(r.rows for r in group)
 
     def _purge_expired_locked(self) -> None:
         """Fail and drop every queued request whose deadline has
@@ -352,9 +393,9 @@ class MicroBatcher:
                 ))
 
     def _collect_group(self) -> t.List[_Request] | None:
-        """Block for the next same-``(slot, deterministic)`` run of
-        queued requests: up to ``max_batch`` rows, or whatever is
-        queued when the oldest request's deadline expires. Expired
+        """Block for the next dispatchable same-``(slot,
+        deterministic)`` group of queued requests — boundary-waiting in
+        ``"group"`` mode, immediate in ``"continuous"`` mode. Expired
         requests are purged here — group-collection time — so the
         engine only ever runs live work. ``None`` means shutdown with
         an empty queue; an empty list means everything queued had
@@ -367,51 +408,102 @@ class MicroBatcher:
                 if not self._running:
                     return None
                 self._nonempty.wait(timeout=0.05)
-            head = self._queue[0]
-            deadline = head.t_enq + self.max_wait_s
-
-            def ready_rows():
-                rows = 0
-                for r in self._queue:
-                    if (r.slot, r.deterministic) != (
-                        head.slot, head.deterministic
-                    ):
-                        break
-                    rows += r.rows
-                return rows
-
-            # A single oversized request flushes immediately (it fills
-            # max_batch on its own); otherwise wait for more rows until
-            # the head's deadline.
-            while self._running and ready_rows() < self.max_batch:
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
-                self._nonempty.wait(timeout=remaining)
-            # Final purge before dispatch: whatever expired during the
-            # coalescing wait is failed now, never forwarded.
-            self._purge_expired_locked()
-            if not self._queue:
-                return []
-            head = self._queue[0]  # the purge may have changed the head
-            group: t.List[_Request] = []
-            rows = 0
-            while self._queue:
-                r = self._queue[0]
-                if (r.slot, r.deterministic) != (head.slot, head.deterministic):
-                    break
-                if group and rows + r.rows > self.max_batch:
-                    break  # next group picks it up (oversized head is
-                    # taken alone and chunked by _run_group)
-                group.append(self._queue.popleft())
-                rows += r.rows
-                if rows >= self.max_batch:
-                    break
-            if self.span_log is not None:
-                t_collect = time.perf_counter()
-                for r in group:
-                    r.t_collect = t_collect
+            if self.mode == "continuous":
+                group = self._collect_continuous_locked()
+            else:
+                group = self._collect_boundary_locked()
+            if group:
+                self._inflight_rows += sum(r.rows for r in group)
+                if self.span_log is not None:
+                    t_collect = time.perf_counter()
+                    for r in group:
+                        r.t_collect = t_collect
             return group
+
+    @staticmethod
+    def _urgency(r: _Request) -> t.Tuple[bool, float, float]:
+        """Priority key: earliest deadline first; deadline-free
+        requests after every deadlined one, FIFO among themselves."""
+        return (r.deadline is None, r.deadline or 0.0, r.t_enq)
+
+    def _collect_continuous_locked(self) -> t.List[_Request]:
+        """Admit-into-next-dispatch: take everything queued for the
+        most urgent request's ``(slot, deterministic)`` class — most
+        urgent first — up to ``max_batch`` rows, with NO coalescing
+        wait. The engine's forward time is the batching window: rows
+        that arrived while the previous group ran ride this one, a
+        lone request at low load dispatches immediately, and a
+        near-deadline request preempts batch-filling by deadline-free
+        traffic. Callers hold ``self._lock``."""
+        head = min(self._queue, key=self._urgency)
+        cls = (head.slot, head.deterministic)
+        candidates = sorted(
+            (r for r in self._queue
+             if (r.slot, r.deterministic) == cls),
+            key=self._urgency,
+        )
+        group: t.List[_Request] = []
+        rows = 0
+        for r in candidates:
+            if group and rows + r.rows > self.max_batch:
+                break  # a later dispatch picks it up (an oversized
+                # head is taken alone and chunked by _run_group)
+            group.append(r)
+            rows += r.rows
+            if rows >= self.max_batch:
+                break
+        taken = {id(r) for r in group}
+        live = [r for r in self._queue if id(r) not in taken]
+        self._queue.clear()
+        self._queue.extend(live)
+        return group
+
+    def _collect_boundary_locked(self) -> t.List[_Request]:
+        """The compat ``"group"`` mode: hold the forming group up to
+        ``max_wait_ms`` past the oldest request hoping to fill
+        ``max_batch`` rows; strict FIFO within the head's class.
+        Callers hold ``self._lock``."""
+        head = self._queue[0]
+        deadline = head.t_enq + self.max_wait_s
+
+        def ready_rows():
+            rows = 0
+            for r in self._queue:
+                if (r.slot, r.deterministic) != (
+                    head.slot, head.deterministic
+                ):
+                    break
+                rows += r.rows
+            return rows
+
+        # A single oversized request flushes immediately (it fills
+        # max_batch on its own); otherwise wait for more rows until
+        # the head's deadline.
+        while self._running and ready_rows() < self.max_batch:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            self._nonempty.wait(timeout=remaining)
+        # Final purge before dispatch: whatever expired during the
+        # coalescing wait is failed now, never forwarded.
+        self._purge_expired_locked()
+        if not self._queue:
+            return []
+        head = self._queue[0]  # the purge may have changed the head
+        group: t.List[_Request] = []
+        rows = 0
+        while self._queue:
+            r = self._queue[0]
+            if (r.slot, r.deterministic) != (head.slot, head.deterministic):
+                break
+            if group and rows + r.rows > self.max_batch:
+                break  # next group picks it up (oversized head is
+                # taken alone and chunked by _run_group)
+            group.append(self._queue.popleft())
+            rows += r.rows
+            if rows >= self.max_batch:
+                break
+        return group
 
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
@@ -538,6 +630,19 @@ class MicroBatcher:
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._queue)
+
+    def load_rows(self) -> int:
+        """Queued + in-flight rows — the backlog the engine still owes.
+        The fleet's least-loaded dispatcher scores replicas by
+        ``load_rows() x ema_row_s`` (estimated seconds to clear)."""
+        with self._lock:
+            return sum(r.rows for r in self._queue) + self._inflight_rows
+
+    @property
+    def ema_row_s(self) -> float | None:
+        """Measured seconds-per-row EMA (None until the first group)."""
+        with self._lock:
+            return self._ema_row_s
 
     def close(self, timeout: float = 10.0):
         """Stop accepting work, flush everything queued, join the

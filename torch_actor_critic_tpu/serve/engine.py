@@ -154,6 +154,18 @@ class PolicyEngine:
         self._warmed = False
         self._watchdog = get_watchdog().install()
 
+    def replicate(self) -> "PolicyEngine":
+        """A fresh engine with this one's configuration and an EMPTY
+        jit cache — the per-device replica constructor
+        (:mod:`~torch_actor_critic_tpu.serve.fleet`): each device
+        needs its own compiled executables and compile accounting,
+        while actor definition, obs spec and bucket ladder are
+        shared."""
+        return PolicyEngine(
+            self.actor_def, self.obs_spec, max_batch=self.max_batch,
+            buckets=self.buckets,
+        )
+
     # ----------------------------------------------------------- buckets
 
     def bucket_for(self, n: int) -> int:

@@ -1,0 +1,385 @@
+"""Engine-per-device replication behind one shared admission layer.
+
+One serving process used to drive ONE device: the batcher's single
+dispatcher thread fed a single :class:`PolicyEngine`, and every other
+local chip idled. :class:`EngineFleet` closes that gap (ROADMAP item 3a,
+the Sebulba/TorchBeast decoupling applied to inference): it builds one
+engine **replica per local device** — its own bucketed jit cache, its
+own params copy placed on that device, its own dispatcher thread — and
+routes every submit through a **least-loaded dispatcher** so all
+devices stay saturated under concurrent traffic.
+
+Layering (everything below the fleet is the existing single-device
+stack, unchanged):
+
+- **Replica** = ``(device, per-device registry view, MicroBatcher)``.
+  The registry view (:class:`_ReplicaRegistry`) satisfies the exact
+  interface the batcher already consumes (``acquire``/``breaker``), so
+  each replica IS a complete single-device serving stack; the fleet
+  only decides which one a request joins.
+- **Params placement is generation-keyed**: ``acquire`` compares the
+  shared registry's generation against the replica's cached copy and
+  re-places on change — a hot-reload swap in the shared registry
+  propagates to every device on its next dispatch, no fleet-aware
+  reload plumbing needed.
+- **Least-loaded dispatch**: score = ``load_rows() x ema_row_s`` —
+  queued + in-flight rows times the replica's own measured
+  seconds-per-row EMA, i.e. estimated seconds until the replica could
+  run the new request. Ties (all idle) break round-robin so bursts
+  spread instead of piling on replica 0.
+- **Health gating**: each replica owns its OWN per-slot circuit
+  breaker (a device can fail alone); the dispatcher skips replicas
+  whose breaker for the requested slot does not admit, which ejects a
+  sick device from rotation and re-admits it when its half-open probe
+  succeeds. Only when EVERY replica is open does the fleet shed with
+  :class:`~torch_actor_critic_tpu.serve.admission.BreakerOpenError`.
+- **Shared admission**: one fleet-wide ``capacity`` bound over the sum
+  of replica queues (checked atomically with routing under the fleet
+  lock), one shared :class:`ServeMetrics`, one deadline vocabulary —
+  clients observe a single service, N times wider.
+
+Provable on CPU: tests force ``--xla_force_host_platform_device_count``
+so replicas land on distinct (virtual) devices and XLA runs each
+replica's forwards on its own device buffers (docs/SERVING.md "Fleet").
+"""
+
+from __future__ import annotations
+
+import threading
+import typing as t
+from concurrent.futures import Future
+
+import jax
+
+from torch_actor_critic_tpu.serve.admission import (
+    BreakerOpenError,
+    ShedError,
+)
+from torch_actor_critic_tpu.serve.batcher import ActResult, MicroBatcher
+from torch_actor_critic_tpu.serve.breaker import CircuitBreaker
+from torch_actor_critic_tpu.serve.engine import PolicyEngine
+from torch_actor_critic_tpu.serve.metrics import ServeMetrics
+
+__all__ = ["EngineFleet"]
+
+# Pessimistic seconds-per-row placeholder while a replica's EMA warms
+# up (first group not yet measured). Deliberately LARGE: a replica
+# with backlog whose service rate is unknown (its first group never
+# came back — possibly wedged) yields to any idle or measured-fast
+# peer, while a fully idle cold fleet still spreads round-robin
+# (0 rows x anything = 0).
+_DEFAULT_ROW_S = 1.0
+
+
+class _ReplicaRegistry:
+    """A per-device view over the shared :class:`ModelRegistry`.
+
+    Presents the registry interface the batcher consumes, but
+    ``acquire`` answers with THIS device's engine replica and a
+    device-placed params copy (cached, re-placed when the shared
+    slot's generation moves), and ``breaker`` answers with this
+    replica's own per-slot breaker. Slot validation, hot-reload and
+    checkpoint plumbing all stay in the one shared registry.
+    """
+
+    def __init__(self, base, device, index: int):
+        self._base = base
+        self.device = device
+        self.index = index
+        self._engines: t.Dict[str, PolicyEngine] = {}
+        self._params: t.Dict[str, t.Tuple[int, t.Any]] = {}
+        self._breakers: t.Dict[str, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    def acquire(self, name: str = "default"):
+        base_engine, params, generation = self._base.acquire(name)
+        with self._lock:
+            engine = self._engines.get(name)
+            if engine is None:
+                engine = base_engine.replicate()
+                self._engines[name] = engine
+            cached = self._params.get(name)
+            if cached is None or cached[0] != generation:
+                # One transfer per hot-reload per device, performed
+                # lazily on the replica's next dispatch — never on the
+                # reload path itself (reload latency stays O(1 restore),
+                # not O(devices)).
+                placed = jax.device_put(params, self.device)
+                self._params[name] = (generation, placed)
+            return engine, self._params[name][1], generation
+
+    def breaker(self, name: str = "default") -> CircuitBreaker | None:
+        base = self._base.breaker(name)
+        if base is None:
+            return None
+        with self._lock:
+            b = self._breakers.get(name)
+            if b is None:
+                # Same thresholds/clock as the slot's shared breaker,
+                # but per-replica state: one sick device trips ITS
+                # breaker and leaves the others serving. Events route
+                # into the shared registry's bounded log, tagged with
+                # the replica.
+                b = CircuitBreaker(
+                    fail_threshold=base.fail_threshold,
+                    cooldown_s=base.cooldown_s,
+                    probe_quota=base.probe_quota,
+                    clock=base._clock,
+                    name=f"{name}@r{self.index}",
+                )
+                b.on_event = lambda ev: self._base.note_breaker_event(
+                    dict(ev, slot=name, replica=self.index)
+                )
+                self._breakers[name] = b
+            return b
+
+    def warmup(self, name: str = "default", **kwargs) -> list:
+        engine, params, _ = self.acquire(name)
+        return engine.warmup(params, **kwargs)
+
+    def breaker_stats(self) -> dict:
+        with self._lock:
+            return {
+                name: b.snapshot() for name, b in self._breakers.items()
+            }
+
+    def compile_stats(self) -> dict:
+        with self._lock:
+            engines = dict(self._engines)
+        return {name: e.compile_stats() for name, e in engines.items()}
+
+
+class _Replica:
+    __slots__ = ("index", "device", "registry", "batcher", "dispatched")
+
+    def __init__(self, index, device, registry, batcher):
+        self.index = index
+        self.device = device
+        self.registry = registry
+        self.batcher = batcher
+        self.dispatched = 0  # requests routed here (fleet-lock guarded)
+
+
+class EngineFleet:
+    """N single-device serving stacks behind one admission layer.
+
+    Duck-types the :class:`MicroBatcher` surface the server and
+    clients consume (``submit``/``act``/``queue_depth``/``close``/
+    ``capacity``/``metrics``/``mode``), so
+    :class:`~torch_actor_critic_tpu.serve.server.PolicyServer` drives
+    a fleet exactly as it drives one batcher.
+
+    ``devices`` defaults to every local device; pass an explicit list
+    (tests pin replicas to forced CPU devices) or an int to take the
+    first N. ``capacity`` is fleet-wide: the bound applies to the SUM
+    of replica queues, checked atomically with routing.
+    """
+
+    def __init__(
+        self,
+        registry,
+        devices: t.Sequence | int | None = None,
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+        metrics: ServeMetrics | None = None,
+        seed: int = 0,
+        capacity: int = 1024,
+        span_log=None,
+        mode: str = "continuous",
+    ):
+        if isinstance(devices, int):
+            devices = jax.local_devices()[:devices]
+        devices = list(devices if devices is not None else jax.local_devices())
+        if not devices:
+            raise ValueError("EngineFleet needs at least one device")
+        self.registry = registry
+        self.capacity = int(capacity)
+        self.max_batch = int(max_batch)
+        self.mode = mode
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.span_log = span_log
+        self._lock = threading.Lock()
+        self._rr = 0  # round-robin cursor for idle ties
+        self._running = True
+        self._replicas = []
+        for i, dev in enumerate(devices):
+            view = _ReplicaRegistry(registry, dev, i)
+            batcher = MicroBatcher(
+                view, max_batch=max_batch, max_wait_ms=max_wait_ms,
+                metrics=self.metrics, seed=seed * 7919 + i,
+                capacity=capacity, span_log=span_log, mode=mode,
+            )
+            self._replicas.append(_Replica(i, dev, view, batcher))
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self._replicas)
+
+    def warmup(
+        self, slots: t.Sequence[str] | None = None, **kwargs
+    ) -> dict:
+        """Compile every replica's buckets for ``slots`` (default: all
+        registered) so no live request pays a per-device compile."""
+        if slots is None:
+            slots = list(self.registry.slots())
+        out = {}
+        for rep in self._replicas:
+            out[f"r{rep.index}"] = {
+                s: len(rep.registry.warmup(s, **kwargs)) for s in slots
+            }
+        return out
+
+    # ------------------------------------------------------------- routing
+
+    def _pick_locked(self, slot: str):
+        """Least-loaded admitting replica, or None when every
+        replica's breaker for ``slot`` is refusing traffic."""
+        n = len(self._replicas)
+        best, best_score = None, None
+        for off in range(n):
+            rep = self._replicas[(self._rr + off) % n]
+            br = rep.registry.breaker(slot)
+            if br is not None and not br.admits():
+                continue  # health gate: breaker-open replica is out
+                # of rotation until its half-open probe re-admits it
+            ema = rep.batcher.ema_row_s
+            score = rep.batcher.load_rows() * (
+                ema if ema is not None else _DEFAULT_ROW_S
+            )
+            if best_score is None or score < best_score:
+                best, best_score = rep, score
+        if best is not None:
+            self._rr = (best.index + 1) % n
+        return best
+
+    def submit(
+        self,
+        obs: t.Any,
+        deterministic: bool = True,
+        slot: str = "default",
+        deadline_s: float | None = None,
+        request_id: str | None = None,
+    ) -> Future:
+        """Route one request to the least-loaded healthy replica;
+        returns that replica's batcher Future. Admission failures
+        raise the same structured
+        :class:`~torch_actor_critic_tpu.serve.admission.ShedError`
+        vocabulary as the single-device batcher."""
+        with self._lock:
+            if not self._running:
+                raise ShedError(
+                    "draining",
+                    "EngineFleet is closed (draining); not accepting "
+                    "new requests",
+                )
+            total = sum(
+                rep.batcher.queue_depth() for rep in self._replicas
+            )
+            if total >= self.capacity:
+                self.metrics.record_shed("queue_full")
+                raise ShedError(
+                    "queue_full",
+                    f"fleet admission queue is at capacity "
+                    f"({self.capacity} requests across "
+                    f"{len(self._replicas)} replicas); retry with "
+                    "backoff",
+                    retry_after_s=1.0,
+                    detail={
+                        "queue_depth": total, "capacity": self.capacity,
+                    },
+                )
+            rep = self._pick_locked(slot)
+            if rep is None:
+                # Every replica's breaker is open: the fleet-level 503.
+                brs = [
+                    r.registry.breaker(slot) for r in self._replicas
+                ]
+                retry = min(
+                    (b.retry_after_s() for b in brs if b is not None),
+                    default=1.0,
+                )
+                self.metrics.record_shed("breaker_open")
+                raise BreakerOpenError(slot, retry, "open")
+            rep.dispatched += 1
+            # Submit under the fleet lock so capacity-check + route +
+            # enqueue are atomic (an enqueue is cheap; forwards happen
+            # on the replicas' own dispatcher threads).
+            return rep.batcher.submit(
+                obs, deterministic, slot, deadline_s=deadline_s,
+                request_id=request_id,
+            )
+
+    def act(
+        self,
+        obs: t.Any,
+        deterministic: bool = True,
+        slot: str = "default",
+        timeout: float | None = 30.0,
+        request_id: str | None = None,
+    ) -> ActResult:
+        """Blocking :meth:`submit`; the timeout doubles as the request
+        deadline, exactly as the single-device batcher."""
+        return self.submit(
+            obs, deterministic, slot, deadline_s=timeout,
+            request_id=request_id,
+        ).result(timeout=timeout)
+
+    # --------------------------------------------------------------- admin
+
+    def queue_depth(self) -> int:
+        return sum(rep.batcher.queue_depth() for rep in self._replicas)
+
+    def load_rows(self) -> int:
+        return sum(rep.batcher.load_rows() for rep in self._replicas)
+
+    def replica_stats(self) -> t.List[dict]:
+        """Per-replica view for ``/metrics`` ``fleet``: device, load,
+        measured service rate, routed-request share, breaker states."""
+        out = []
+        with self._lock:
+            reps = list(self._replicas)
+        for rep in reps:
+            ema = rep.batcher.ema_row_s
+            out.append({
+                "replica": rep.index,
+                "device": str(rep.device),
+                "queue_depth": rep.batcher.queue_depth(),
+                "load_rows": rep.batcher.load_rows(),
+                "ema_row_s": round(ema, 6) if ema is not None else None,
+                "dispatched_total": rep.dispatched,
+                "breakers": {
+                    name: s["state"]
+                    for name, s in rep.registry.breaker_stats().items()
+                },
+            })
+        return out
+
+    def compile_stats(self) -> dict:
+        """Per-replica engine compile accounting (the fleet twin of
+        ``ModelRegistry.compile_stats``)."""
+        reps = {
+            f"r{rep.index}": rep.registry.compile_stats()
+            for rep in self._replicas
+        }
+        totals = [
+            s for per in reps.values() for s in per.values()
+        ]
+        return {
+            "compiles_total": sum(s["compiles_total"] for s in totals),
+            "live_compiles": sum(s["live_compiles"] for s in totals),
+            "replicas": reps,
+        }
+
+    def close(self, timeout: float = 10.0):
+        """Stop admitting, then flush every replica's queue through
+        its engine (the batcher close contract, N times)."""
+        with self._lock:
+            self._running = False
+        for rep in self._replicas:
+            rep.batcher.close(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

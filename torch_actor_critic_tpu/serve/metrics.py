@@ -20,7 +20,18 @@ import typing as t
 
 from torch_actor_critic_tpu.telemetry.histogram import FixedBucketHistogram
 
-__all__ = ["ServeMetrics"]
+__all__ = ["ServeMetrics", "aggregate_snapshots"]
+
+# Monotonic counters a fleet aggregate sums over its CURRENT workers.
+# Summing live values (instead of accumulating deltas over time) is
+# what makes the aggregate restart-safe: a worker that restarted
+# resets its own counters, so the fleet total simply reflects the new
+# process — it can never double-count the dead incarnation.
+_SUM_KEYS = (
+    "requests_total", "responses_total", "errors_total", "batches_total",
+    "queue_depth", "sheds_total", "shed_expired_total",
+    "compiles_total", "live_compiles",
+)
 
 
 class ServeMetrics:
@@ -195,4 +206,80 @@ class ServeMetrics:
                     p99_ms=round(p99, 3),
                     max_ms=round(self._latency.max, 3),
                 )
+            # The mergeable histogram state (counts vector + spec):
+            # a fleet router folds every worker's export into ONE
+            # histogram, so fleet percentiles come from the same
+            # estimator — never from averaging per-worker percentiles,
+            # which is statistically meaningless.
+            out["latency_hist"] = self._latency.raw_counts()
         return out
+
+
+def aggregate_snapshots(
+    workers: t.Mapping[str, t.Mapping[str, t.Any]],
+) -> t.Dict[str, t.Any]:
+    """Fold per-worker ``/metrics`` snapshots into one fleet view
+    (docs/SERVING.md "Fleet").
+
+    Counters are summed over the CURRENT snapshots and every input is
+    kept, per-worker-labelled, under ``workers`` — a worker that
+    restarted resets its own counters, so the fleet totals reflect
+    exactly what the live processes report and can never double-count
+    a dead incarnation. ``requests_per_sec`` is the sum of per-worker
+    window rates (rates of disjoint request streams add). Latency
+    percentiles come from merging every worker's raw bucket counts
+    into one :class:`FixedBucketHistogram` — identical to the
+    histogram one process would have built from all the samples
+    (pinned by tests/test_fleet.py). Workers whose snapshot failed
+    (value ``None``) appear with ``{"unreachable": true}`` and
+    contribute nothing to the totals."""
+    out: t.Dict[str, t.Any] = {k: 0 for k in _SUM_KEYS}
+    out["shed_by_reason"] = {}
+    out["requests_per_sec"] = 0.0
+    per_worker: t.Dict[str, t.Any] = {}
+    merged = FixedBucketHistogram()
+    merge_error = None
+    for name, snap in workers.items():
+        if snap is None:
+            per_worker[name] = {"unreachable": True}
+            continue
+        per_worker[name] = {
+            k: snap.get(k) for k in _SUM_KEYS + (
+                "requests_per_sec", "shed_by_reason", "uptime_s",
+                "p50_ms", "p99_ms", "queue_capacity", "draining",
+            ) if k in snap
+        }
+        for k in _SUM_KEYS:
+            v = snap.get(k)
+            if isinstance(v, (int, float)):
+                out[k] += int(v)
+        for reason, n in (snap.get("shed_by_reason") or {}).items():
+            out["shed_by_reason"][reason] = (
+                out["shed_by_reason"].get(reason, 0) + int(n)
+            )
+        rps = snap.get("requests_per_sec")
+        if isinstance(rps, (int, float)):
+            out["requests_per_sec"] = round(
+                out["requests_per_sec"] + float(rps), 2
+            )
+        hist = snap.get("latency_hist")
+        if hist is not None:
+            try:
+                merged.merge_raw(hist)
+            except (ValueError, KeyError, TypeError) as e:
+                merge_error = repr(e)[:200]
+    if merged.count:
+        p50, p95, p99 = merged.percentiles((50, 95, 99))
+        out.update(
+            mean_ms=round(merged.mean, 3), p50_ms=round(p50, 3),
+            p95_ms=round(p95, 3), p99_ms=round(p99, 3),
+            max_ms=round(merged.max, 3),
+        )
+    out["latency_hist"] = merged.raw_counts()
+    if merge_error is not None:
+        out["latency_merge_error"] = merge_error
+    out["workers"] = per_worker
+    out["workers_reporting"] = sum(
+        1 for v in per_worker.values() if not v.get("unreachable")
+    )
+    return out

@@ -257,6 +257,14 @@ class ModelRegistry:
         self._breaker_events.append(event)
         logger.warning("breaker event: %s", event)
 
+    def note_breaker_event(self, event: dict):
+        """Record one breaker transition into the bounded event log —
+        the hook per-device replica breakers
+        (:mod:`~torch_actor_critic_tpu.serve.fleet`) report through,
+        so fleet and slot breaker events share one telemetry stream
+        (entries carry ``replica`` when a replica emitted them)."""
+        self._note_breaker_event(event)
+
     def breaker_events(self) -> t.List[dict]:
         """The most recent breaker transitions (bounded), each a
         JSONL-ready telemetry event dict."""

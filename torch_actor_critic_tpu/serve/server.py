@@ -35,8 +35,10 @@ from __future__ import annotations
 import json
 import logging
 import math
+import random
 import signal
 import threading
+import time
 import typing as t
 import uuid
 from concurrent.futures import TimeoutError as FutureTimeoutError
@@ -61,12 +63,50 @@ __all__ = ["PolicyClient", "PolicyServer", "install_drain_handler"]
 
 
 class PolicyClient:
-    """Direct in-process access to the serving stack — same batching,
-    no HTTP. One per process is enough; it is thread-safe."""
+    """Access to the serving stack, in-process or over HTTP.
 
-    def __init__(self, registry: ModelRegistry, batcher: MicroBatcher):
+    **In-process mode** (``PolicyClient(registry, batcher)``): the
+    zero-copy path — observations go straight into the micro-batching
+    queue. One per process is enough; it is thread-safe.
+
+    **HTTP mode** (``PolicyClient(url="http://host:port")``): the
+    remote path actors and smoke harnesses use against a worker or a
+    fleet router. ``act`` gains **retry with jittered backoff** that
+    honors the ``Retry-After`` header the overload layer already emits
+    on 429/503 (docs/SERVING.md): on a retryable rejection the client
+    sleeps ``max(Retry-After, backoff·2^attempt)`` plus up to 25%
+    jitter (decorrelates a herd of clients all told "retry in 1s"),
+    for at most ``retries`` retry attempts — and **deadline-aware**:
+    the ``timeout`` passed to ``act`` is the caller's total budget, so
+    a retry that could not complete before the deadline is never
+    started and the last rejection is raised instead. 4xx client
+    errors and 5xx server faults are never retried (retrying a
+    malformed request or a broken engine is not backoff's job).
+    """
+
+    def __init__(
+        self,
+        registry: ModelRegistry | None = None,
+        batcher: MicroBatcher | None = None,
+        url: str | None = None,
+        retries: int = 3,
+        backoff_s: float = 0.25,
+        sleep: t.Callable[[float], None] = time.sleep,
+        rng: random.Random | None = None,
+    ):
+        if (url is None) == (batcher is None):
+            raise ValueError(
+                "pass either (registry, batcher) for in-process mode "
+                "or url= for HTTP mode"
+            )
         self.registry = registry
         self.batcher = batcher
+        self.url = url.rstrip("/") if url is not None else None
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self._sleep = sleep
+        self._rng = rng if rng is not None else random.Random()
+        self.retries_total = 0
 
     def act(
         self,
@@ -76,6 +116,10 @@ class PolicyClient:
         timeout: float | None = 30.0,
         request_id: str | None = None,
     ) -> ActResult:
+        if self.url is not None:
+            return self._act_http(
+                obs, deterministic, slot, timeout, request_id
+            )
         return self.batcher.act(
             obs, deterministic, slot, timeout=timeout, request_id=request_id
         )
@@ -84,9 +128,111 @@ class PolicyClient:
         self, obs: t.Any, deterministic: bool = True, slot: str = "default",
         request_id: str | None = None,
     ):
+        if self.url is not None:
+            raise RuntimeError(
+                "act_async is in-process only; HTTP mode callers run "
+                "act() on their own threads"
+            )
         return self.batcher.submit(
             obs, deterministic, slot, request_id=request_id
         )
+
+    # ---------------------------------------------------------- HTTP mode
+
+    def _act_http(self, obs, deterministic, slot, timeout, request_id):
+        import urllib.error as urlerr
+        import urllib.request as urlreq
+
+        if hasattr(obs, "features"):  # MultiObservation pytree
+            raw_obs: t.Any = {
+                "features": np.asarray(obs.features).tolist(),
+                "frame": np.asarray(obs.frame).tolist(),
+            }
+        else:
+            raw_obs = np.asarray(obs).tolist()
+        body = json.dumps({
+            "obs": raw_obs, "deterministic": bool(deterministic),
+            "model": slot,
+        }).encode()
+        deadline = (
+            time.perf_counter() + timeout if timeout is not None else None
+        )
+        attempt = 0
+        while True:
+            remaining = (
+                deadline - time.perf_counter()
+                if deadline is not None else None
+            )
+            if remaining is not None and remaining <= 0:
+                raise ShedError(
+                    "deadline_infeasible",
+                    f"client deadline of {timeout:.3f}s exhausted "
+                    f"before attempt {attempt + 1}",
+                )
+            headers = {"Content-Type": "application/json"}
+            if request_id is not None:
+                headers["X-Request-Id"] = request_id
+            try:
+                req = urlreq.Request(
+                    self.url + "/act", data=body, headers=headers
+                )
+                with urlreq.urlopen(
+                    req, timeout=remaining if remaining is not None else 30.0
+                ) as resp:
+                    out = json.loads(resp.read())
+                return ActResult(
+                    np.asarray(out["action"], dtype=np.float32),
+                    int(out.get("generation", 0)),
+                )
+            except urlerr.HTTPError as e:
+                try:
+                    payload = json.loads(e.read())
+                except (ValueError, OSError):
+                    payload = {}
+                if e.code not in (429, 503):
+                    raise RuntimeError(
+                        f"/act failed with HTTP {e.code}: "
+                        f"{payload.get('error', '')}"
+                    ) from None
+                reason = payload.get("reason", f"http_{e.code}")
+                if attempt >= self.retries:
+                    raise ShedError(
+                        reason,
+                        payload.get(
+                            "error",
+                            f"rejected with {e.code} after "
+                            f"{attempt + 1} attempts",
+                        ),
+                        retry_after_s=float(
+                            payload.get("retry_after_s", 1.0)
+                        ),
+                        detail=payload,
+                    ) from None
+                ra = e.headers.get("Retry-After") if e.headers else None
+                delay = max(
+                    float(ra) if ra else 0.0,
+                    self.backoff_s * (2 ** attempt),
+                )
+                delay *= 1.0 + 0.25 * self._rng.random()  # jitter
+                if deadline is not None and (
+                    time.perf_counter() + delay >= deadline
+                ):
+                    # Never retry past the caller's deadline: raise
+                    # the rejection we have instead of one we'd
+                    # manufacture by timing out mid-retry.
+                    raise ShedError(
+                        reason,
+                        payload.get(
+                            "error",
+                            f"rejected with {e.code}; deadline too "
+                            "near to honor Retry-After",
+                        ),
+                        retry_after_s=delay,
+                        detail=payload,
+                    ) from None
+                self.retries_total += 1
+                attempt += 1
+                self._sleep(delay)
 
 
 def _parse_obs(raw, obs_spec):
@@ -131,6 +277,8 @@ class PolicyServer:
         extra_snapshot: t.Callable[[], dict] | None = None,
         capacity: int = 1024,
         span_log=None,
+        mode: str = "continuous",
+        devices: t.Sequence | int | None = None,
     ):
         self.registry = registry
         # Per-request trace spans (telemetry.traceview.RequestSpanLog):
@@ -151,11 +299,29 @@ class PolicyServer:
         self.request_timeout_s = float(request_timeout_s)
         self.act_timeout_s = float(act_timeout_s)
         self.metrics = metrics if metrics is not None else ServeMetrics()
-        self.batcher = MicroBatcher(
-            registry, max_batch=max_batch, max_wait_ms=max_wait_ms,
-            metrics=self.metrics, seed=seed, capacity=capacity,
-            span_log=span_log,
-        )
+        # devices=None (or 1) keeps the single-device batcher; an int
+        # > 1 or an explicit device list builds an EngineFleet — one
+        # engine replica per device behind this server's one admission
+        # layer (serve/fleet.py). The fleet duck-types the batcher
+        # surface, so everything downstream is unchanged.
+        if devices is not None and not (
+            isinstance(devices, int) and devices <= 1
+        ):
+            from torch_actor_critic_tpu.serve.fleet import EngineFleet
+
+            self.batcher: t.Any = EngineFleet(
+                registry, devices=devices, max_batch=max_batch,
+                max_wait_ms=max_wait_ms, metrics=self.metrics,
+                seed=seed, capacity=capacity, span_log=span_log,
+                mode=mode,
+            )
+            self.batcher.warmup()
+        else:
+            self.batcher = MicroBatcher(
+                registry, max_batch=max_batch, max_wait_ms=max_wait_ms,
+                metrics=self.metrics, seed=seed, capacity=capacity,
+                span_log=span_log, mode=mode,
+            )
         self.client = PolicyClient(registry, self.batcher)
         # Graceful-drain state (docs/SERVING.md "Overload &
         # degradation"): once draining, /healthz answers 503 so load
@@ -224,6 +390,14 @@ class PolicyServer:
                     snap["queue_capacity"] = server.batcher.capacity
                     snap["draining"] = server._draining
                     snap["breakers"] = server.registry.breaker_stats()
+                    # Engine-per-device fleet view (serve/fleet.py):
+                    # per-replica load/EMA/dispatch share + per-replica
+                    # breaker states and compile accounting.
+                    if hasattr(server.batcher, "replica_stats"):
+                        snap["fleet"] = {
+                            "replicas": server.batcher.replica_stats(),
+                            "compiles": server.batcher.compile_stats(),
+                        }
                     # Per-bucket live roofline: registered program
                     # FLOPs/bytes over measured forward time
                     # (docs/OBSERVABILITY.md "Cost attribution").
@@ -353,6 +527,10 @@ class PolicyServer:
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
         self._thread: threading.Thread | None = None
+        # shutdown() on a loop that NEVER ran blocks forever (stdlib
+        # waits on the flag only serve_forever sets); close() skips it
+        # unless one of the serve entry points actually started.
+        self._loop_started = False
 
     @property
     def port(self) -> int:
@@ -369,6 +547,7 @@ class PolicyServer:
         # serving-bucket compile is a steady-state anomaly (slots that
         # register later run their warmup as `expected`).
         _watchdog().install().mark_steady("serve/")
+        self._loop_started = True
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="policy-http", daemon=True
         )
@@ -378,6 +557,7 @@ class PolicyServer:
     def serve_forever(self):
         """Block serving until interrupted (the CLI path)."""
         _watchdog().install().mark_steady("serve/")
+        self._loop_started = True
         try:
             self._httpd.serve_forever()
         except KeyboardInterrupt:  # pragma: no cover — operator stop
@@ -431,7 +611,8 @@ class PolicyServer:
         know a non-daemon-joinable thread is still out there."""
         result = {"server_thread_stopped": True}
         _watchdog().clear_steady("serve/")
-        self._httpd.shutdown()
+        if self._loop_started:
+            self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=thread_join_timeout_s)

@@ -188,6 +188,56 @@ class FixedBucketHistogram:
             })
         return out
 
+    def spec(self) -> dict:
+        """The bucket spec two histograms must share to merge:
+        ``{lo, growth, n_buckets}`` (``hi`` is derived). Serialized
+        alongside :meth:`raw_counts` in cross-process exports so the
+        merging side can verify compatibility instead of silently
+        folding counts into the wrong bounds."""
+        return {
+            "lo": self._lo,
+            "growth": round(math.exp(self._log_growth), 12),
+            "n_buckets": self._n,
+        }
+
+    def raw_counts(self) -> dict:
+        """The full mergeable state as JSON-ready scalars: the counts
+        vector (underflow + geometric + overflow) plus the exact side
+        statistics and the bucket :meth:`spec`. A fleet router folds N
+        workers' exports into one histogram via :meth:`merge_counts`
+        (docs/SERVING.md "Fleet"), giving fleet-level percentiles from
+        the same estimator each worker reports — impossible to
+        reconstruct from the workers' individual percentiles."""
+        return {
+            "counts": list(self._counts),
+            "total": self.total,
+            "min": self.min if self.count else None,
+            "max": self.max,
+            "spec": self.spec(),
+        }
+
+    def merge_raw(self, raw: t.Mapping[str, t.Any]) -> None:
+        """Fold one :meth:`raw_counts` export into this histogram,
+        validating the bucket spec first."""
+        spec = raw.get("spec") or {}
+        mine = self.spec()
+        if (
+            spec.get("n_buckets") != mine["n_buckets"]
+            or abs(spec.get("lo", -1.0) - mine["lo"]) > 1e-12
+            or abs(spec.get("growth", -1.0) - mine["growth"]) > 1e-9
+        ):
+            raise ValueError(
+                f"histogram spec mismatch: cannot merge {spec} into "
+                f"{mine}"
+            )
+        vmin = raw.get("min")
+        self.merge_counts(
+            raw["counts"],
+            total=float(raw.get("total", 0.0)),
+            vmin=math.inf if vmin is None else float(vmin),
+            vmax=float(raw.get("max", 0.0)),
+        )
+
     def buckets(self) -> t.List[t.Tuple[float, int]]:
         """Non-empty ``(upper_bound, count)`` pairs, for export/debug.
         The overflow bucket reports ``inf`` as its bound."""

@@ -43,6 +43,7 @@ __all__ = [
     "RequestSpanLog",
     "compile_events",
     "export_trace",
+    "router_hop_events",
     "serve_request_events",
     "span_event",
     "training_events",
@@ -52,6 +53,7 @@ __all__ = [
 TRAIN_PID = 1
 SERVE_PID = 2
 XLA_PID = 3
+ROUTER_PID = 4
 
 _ANCHOR: t.Tuple[float, float] | None = None
 _ANCHOR_LOCK = threading.Lock()
@@ -192,6 +194,36 @@ def serve_request_events(records: t.Iterable[dict]) -> t.List[dict]:
     return events
 
 
+def router_hop_events(records: t.Iterable[dict]) -> t.List[dict]:
+    """Fleet-router hop records -> trace events on the router pid.
+
+    Each record is one proxy attempt the router's span log captured:
+    ``{request_id, worker, t_route, t_done, outcome}``. The span is
+    named ``hop <worker>`` and carries the base ``X-Request-Id`` in
+    ``args`` — the same id the worker saw hop-tagged
+    (``<rid>><worker>``), so the router hop, the worker's ``request``
+    span and the engine forward stitch into one request's timeline
+    when the exports are merged (docs/SERVING.md "Fleet"). Wall-clock
+    skew between the router and worker *processes* bounds the stitch
+    accuracy, as for every cross-process merge (module docstring)."""
+    events: t.List[dict] = []
+    for i, rec in enumerate(records):
+        t0 = rec.get("t_route")
+        t1 = rec.get("t_done")
+        if t0 is None or t1 is None:
+            continue
+        args = {
+            k: rec[k]
+            for k in ("request_id", "worker", "outcome")
+            if rec.get(k) is not None
+        }
+        events.extend(span_event(
+            f"hop {rec.get('worker', '?')}", perf_to_us(t0),
+            (t1 - t0) * 1e6, ROUTER_PID, i % 64, args=args,
+        ))
+    return events
+
+
 def compile_events(records: t.Iterable[dict]) -> t.List[dict]:
     """Watchdog compile records (``{source, time, duration_s}``, wall
     clock) -> trace events on the XLA pid. The monitoring event fires
@@ -213,7 +245,8 @@ def compile_events(records: t.Iterable[dict]) -> t.List[dict]:
 def _metadata_events() -> t.List[dict]:
     out = []
     for pid, name in (
-        (TRAIN_PID, "train"), (SERVE_PID, "serve"), (XLA_PID, "xla-compile"),
+        (TRAIN_PID, "train"), (SERVE_PID, "serve"),
+        (XLA_PID, "xla-compile"), (ROUTER_PID, "router"),
     ):
         out.append({
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
@@ -250,6 +283,7 @@ def export_trace(path: str | os.PathLike, *event_lists: t.List[dict]) -> dict:
         "train_spans": by_pid.get(TRAIN_PID, 0),
         "serve_spans": by_pid.get(SERVE_PID, 0),
         "compile_spans": by_pid.get(XLA_PID, 0),
+        "router_spans": by_pid.get(ROUTER_PID, 0),
     }
     logger.info(
         "trace exported: %s (%d train / %d serve / %d compile spans)",
