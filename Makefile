@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke
+.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke decouple-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -114,6 +114,17 @@ chaos-smoke:
 # graceful SIGTERM teardown (docs/SERVING.md "Fleet").
 fleet-smoke:
 	JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+
+# Decoupled actor/learner chaos: (1) in-process bitwise proof — SIGTERM
+# mid-epoch with a staged-transition tail, resume is bitwise on learner
+# state AND replay; (2) real processes — learner acts over HTTP through
+# a serve.py worker hot-reloading its checkpoints, the worker is
+# SIGKILLed mid-collection (actors degrade to the local snapshot, envs
+# never stall), the learner SIGTERMs mid-epoch (requeue 75) and
+# resumes: zero accepted transitions lost, staleness bounded by
+# --max-actor-lag (docs/RESILIENCE.md "Decoupled-plane failure modes").
+decouple-smoke:
+	JAX_PLATFORMS=cpu python scripts/decouple_smoke.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

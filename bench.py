@@ -1828,6 +1828,91 @@ def bench_telemetry_overhead(budget_s=420.0):
     return out
 
 
+def bench_decoupled(budget_s=420.0, max_actor_lag=4):
+    """Decoupled actor/learner cost at equal config (docs/RESILIENCE.md
+    "Decoupled-plane failure modes"): steady-state env-steps/s and
+    grad-steps/s of the lockstep Trainer vs the DecoupledTrainer —
+    every policy action through the real registry/batcher/client stack,
+    transitions through the staging gate — plus the observed staleness
+    distribution against ``--max-actor-lag`` (steady-state inline lag
+    is exactly one publish). The delta IS the serving-plane toll on the
+    act path; bench-diff picks the throughput keys up via its existing
+    ``*_per_sec`` directions."""
+    from torch_actor_critic_tpu.decoupled import DecoupledTrainer
+    from torch_actor_critic_tpu.parallel import make_mesh
+    from torch_actor_critic_tpu.sac.trainer import Trainer
+    from torch_actor_critic_tpu.utils.config import SACConfig
+
+    t_start = time.time()
+    tiny = dict(
+        hidden_sizes=(32, 32), batch_size=32, epochs=4,
+        steps_per_epoch=400, start_steps=50, update_after=50,
+        update_every=50, buffer_size=5000, max_ep_len=200,
+        save_every=1000, sentinel=False,
+    )
+    out: dict = {"config": dict(tiny, max_actor_lag=max_actor_lag)}
+    # ABBA order, like the telemetry/diagnostics overhead stages: slow
+    # host drift cancels to first order.
+    rates: dict = {m: [] for m in (
+        "lockstep", "grad_lockstep", "decoupled", "grad_decoupled",
+    )}
+    lag_snap = None
+    for mode in ("lockstep", "decoupled", "decoupled", "lockstep"):
+        if time.time() - t_start > budget_s:
+            break
+        try:
+            if mode == "decoupled":
+                cfg = SACConfig(
+                    **tiny, decoupled=True, max_actor_lag=max_actor_lag
+                )
+                tr = DecoupledTrainer(
+                    "Pendulum-v1", cfg, mesh=make_mesh(dp=1), seed=0
+                )
+            else:
+                tr = Trainer(
+                    "Pendulum-v1", SACConfig(**tiny),
+                    mesh=make_mesh(dp=1), seed=0,
+                )
+            epoch_rates, epoch_grad = [], []
+            real_hook = tr._epoch_boundary_hook
+
+            def hook(e, ok, saved, metrics, rec, _real=real_hook):
+                _real(e, ok, saved, metrics, rec)
+                epoch_rates.append(metrics["env_steps_per_sec"])
+                epoch_grad.append(metrics["grad_steps_per_sec"])
+
+            tr._epoch_boundary_hook = hook
+            try:
+                tr.train()
+                if mode == "decoupled":
+                    lag_snap = tr.staging.snapshot()["actor_lag"]
+            finally:
+                tr.close()
+            # Post-warmup epochs only (epoch 0 pays the jit compiles).
+            rates[mode].extend(epoch_rates[1:])
+            rates[f"grad_{mode}"].extend(epoch_grad[1:])
+        except Exception as e:  # noqa: BLE001 — per-run best effort
+            out.setdefault("errors", []).append(repr(e)[:200])
+    for mode in ("lockstep", "decoupled"):
+        if rates[mode]:
+            out[f"{mode}_env_steps_per_sec"] = round(max(rates[mode]), 1)
+            out[f"{mode}_grad_steps_per_sec"] = round(
+                max(rates[f"grad_{mode}"]), 1
+            )
+    a = out.get("lockstep_env_steps_per_sec")
+    b = out.get("decoupled_env_steps_per_sec")
+    if a and b:
+        out["decoupling_overhead_pct"] = round((a - b) / a * 100, 2)
+    if lag_snap is not None:
+        out["actor_lag"] = lag_snap
+        out["max_actor_lag"] = max_actor_lag
+        out["lag_bounded"] = (
+            lag_snap.get("actor_lag_max", 0.0) <= max_actor_lag
+        )
+    log(f"decoupled: {out}")
+    return out
+
+
 def bench_diagnostics_overhead(budget_s=540.0):
     """Learning-health diagnostics cost (docs/OBSERVABILITY.md
     "Learning-health diagnostics"): steady-state Trainer throughput at
@@ -1998,6 +2083,7 @@ _STAGES = {
     "serving": lambda: {"serving": bench_serving()},
     "overload": lambda: {"overload": bench_overload()},
     "fleet": lambda: {"fleet": bench_fleet()},
+    "decoupled": lambda: {"decoupled": bench_decoupled()},
     "host_envs": lambda: {"host_envs": bench_host_envs()},
     "telemetry_overhead": lambda: {
         "telemetry_overhead": bench_telemetry_overhead()
@@ -2267,6 +2353,18 @@ def main():
     )
     if res and "error" in res:
         diagnostics.append({"fleet_stage_error": res.pop("error")})
+    if res:
+        out.update(res)
+
+    # 5a''''. Decoupled actor/learner (docs/RESILIENCE.md): lockstep vs
+    # acting-through-the-serving-plane throughput at equal config, plus
+    # the staleness distribution against --max-actor-lag. Host-side
+    # cost measurement like the serving stages; same backend.
+    res = run_stage_subprocess(
+        "decoupled", 540, diagnostics, platform=serving_platform
+    )
+    if res and "error" in res:
+        diagnostics.append({"decoupled_stage_error": res.pop("error")})
     if res:
         out.update(res)
 

@@ -1,0 +1,355 @@
+#!/usr/bin/env python
+"""Decoupled-plane chaos smoke (``make decouple-smoke``).
+
+Proves the full "training cluster feeds serving fleet" story survives
+both role deaths in ONE run (docs/RESILIENCE.md "Decoupled-plane
+failure modes"):
+
+Phase 1 — in-process bitwise proof: SIGTERM (programmatic, step-exact)
+lands mid-epoch on a decoupled learner whose staging buffer holds an
+undrained tail; the resumed run's final learner state AND replay ring
+are **bitwise identical** to an uninterrupted twin — zero accepted
+transitions lost.
+
+Phase 2 — subprocess chaos, real signals, real HTTP:
+
+1. a decoupled learner (``train.py --decoupled true --serve-url ...``)
+   starts against a serving port where NOTHING listens yet: actors
+   degrade to the local snapshot from step one (counted);
+2. a real serving worker (``serve.py --run <id>``) comes up on that
+   port, hot-reload-polling the learner's checkpoints: actors probe,
+   RE-HOME, and act through HTTP;
+3. the serving worker is **SIGKILLed mid-collection**: actors degrade
+   again — envs never stall, the learner keeps training;
+4. the learner gets **SIGTERM mid-epoch**: it checkpoints staging +
+   replay and exits with requeue code 75;
+5. the learner resumes (``--run <id>``) and completes.
+
+Asserted at the end: requeue/rc discipline, zero accepted transitions
+lost (the staging conservation invariant over the WHOLE run, across
+the restart), every recorded generation lag <= --max-actor-lag, at
+least one degradation AND one re-home observed, and finite final
+metrics.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+MAX_ACTOR_LAG = 4
+
+TRAIN_FLAGS = [
+    "--environment", "Pendulum-v1",
+    "--hidden-sizes", "16,16",
+    "--batch-size", "16",
+    # Per invocation: 2 epochs of 200 steps (resume adds 2 more each
+    # time). Long enough that signals sent right after an epoch line
+    # appears land MID-epoch, short enough for a CI smoke.
+    "--epochs", "2",
+    "--steps-per-epoch", "200",
+    "--start-steps", "20",
+    "--update-after", "20",
+    "--update-every", "20",
+    "--buffer-size", "2000",
+    "--max-ep-len", "200",
+    "--save-every", "1",
+    "--decoupled", "true",
+    "--max-actor-lag", str(MAX_ACTOR_LAG),
+    "--actor-timeout-s", "2.0",
+    "--telemetry", "true",
+]
+
+
+def log(msg):
+    print(f"[decouple-smoke] {msg}", flush=True)
+
+
+def fail(msg):
+    log(f"FAIL: {msg}")
+    sys.exit(1)
+
+
+# --------------------------------------------------- phase 1: bitwise
+
+
+def phase_bitwise(root: Path):
+    import numpy as np
+
+    from tests.test_decoupled import (  # reuse the pinned helpers
+        comparable_state,
+        make_trainer,
+    )
+    from torch_actor_critic_tpu.resilience import (
+        Preempted,
+        PreemptionGuard,
+    )
+    from torch_actor_critic_tpu.resilience.faultinject import FaultyEnvPool
+
+    # steps_per_epoch=44: the epoch-1 boundary (step 88) sits 8 steps
+    # past the last window drain (step 80), so the preemption save
+    # carries a staged-but-undrained tail that must round-trip.
+    over = dict(epochs=3, steps_per_epoch=44, save_every=10)
+    log("phase 1: uninterrupted twin ...")
+    tra = make_trainer(root / "a", **over)
+    try:
+        tra.train()
+        ref = comparable_state(tra)
+    finally:
+        tra.close()
+
+    log("phase 1: preempted run (SIGTERM at lockstep step 50) ...")
+    guard = PreemptionGuard()  # programmatic: exact, signal-free
+    trb = make_trainer(root / "b", preemption=guard, **over)
+    trb.pool = FaultyEnvPool(trb.pool).call_at(
+        50, lambda: guard.request_preemption()
+    )
+    preempted = False
+    try:
+        try:
+            trb.train()
+        except Preempted:
+            preempted = True
+    finally:
+        trb.close()
+    if not preempted:
+        fail("phase 1: the preemption never fired")
+    staged_tail = trb.checkpointer.peek_meta()["decoupled"]["staging"][
+        "count"
+    ]
+    if staged_tail != 8:
+        fail(f"phase 1: expected an 8-transition staged tail, got "
+             f"{staged_tail}")
+
+    log("phase 1: resume and compare ...")
+    trc = make_trainer(root / "b", **{**over, "epochs": 1})
+    try:
+        if trc.restore() != 2:
+            fail("phase 1: resume landed on the wrong epoch")
+        if trc.staging.depth() != 8:
+            fail("phase 1: staged tail lost across the restart")
+        trc.train()
+        got = comparable_state(trc)
+        if not trc.staging.conservation_holds():
+            fail("phase 1: staging conservation violated")
+    finally:
+        trc.close()
+    for x, y in zip(ref, got):
+        np.testing.assert_array_equal(x, y)
+    log("phase 1 OK: bitwise resume incl. the staged tail "
+        f"({staged_tail} transitions)")
+
+
+# ---------------------------------------------------- phase 2: chaos
+
+
+def metrics_lines(path: Path):
+    if not path.exists():
+        return []
+    out = []
+    for line in path.read_text().splitlines():
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            pass
+    return out
+
+
+def wait_for(predicate, what, timeout_s=240.0, poll_s=0.25):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(poll_s)
+    fail(f"timed out after {timeout_s:.0f}s waiting for {what}")
+
+
+def free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def phase_chaos(root: Path):
+    """Real processes, real signals. Epoch-gated choreography (every
+    signal fires right after an epoch's metrics line lands, i.e. just
+    as the next epoch's collection starts — nothing is timed against
+    wall-clock guesses):
+
+    run 1   learner alone, serving DOWN: actors degrade from the first
+            policy step; exits 0 leaving checkpoints.
+    worker  serve.py --run comes up on the port, hot-reload-polling.
+    run 2   learner resumes: actors act THROUGH the worker over HTTP;
+            after its first epoch line, the worker is SIGKILLed and the
+            learner SIGTERMed — both land mid-collection of the next
+            epoch; the learner checkpoints and exits 75.
+    run 3   learner resumes degraded and completes, rc 0.
+    """
+    import urllib.request as urlreq
+
+    runs_root = root / "runs"
+    port = free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def launch_learner(extra):
+        return subprocess.Popen(
+            [sys.executable, "-m", "torch_actor_critic_tpu.train",
+             *extra,
+             "--runs-root", str(runs_root), "--experiment", "decouple"],
+            cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+
+    log(f"phase 2: run 1 — learner alone, serving :{port} DOWN "
+        "(actors must degrade, envs must not stall) ...")
+    learner = launch_learner(
+        TRAIN_FLAGS + ["--serve-url", f"http://127.0.0.1:{port}"]
+    )
+    worker = None
+    try:
+        rc = learner.wait(timeout=600)
+        if rc != 0:
+            fail(f"run 1 exited rc={rc}")
+        run_dir = next(iter((runs_root / "decouple").glob("*")), None)
+        if run_dir is None:
+            fail("run 1 left no run dir")
+        run_id = run_dir.name
+        metrics = run_dir / "metrics.jsonl"
+        lines = metrics_lines(metrics)
+        if not lines:
+            fail("run 1 logged no epochs")
+        if lines[-1].get("decoupled/fallback_actions_total", 0) <= 0:
+            fail("expected fallback actions while serving was down")
+        if lines[-1].get("decoupled/degradations_total", 0) < 1:
+            fail("expected a degradation while serving was down")
+
+        log(f"phase 2: starting serving worker for run {run_id} ...")
+        worker = subprocess.Popen(
+            [sys.executable, str(REPO / "serve.py"),
+             "--run", run_id, "--runs-root", str(runs_root),
+             "--experiment", "decouple", "--port", str(port),
+             "--poll-interval", "0.25", "--max-batch", "4"],
+            cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+
+        def healthy():
+            try:
+                with urlreq.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2
+                ) as r:
+                    return r.status == 200
+            except Exception:
+                return False
+
+        wait_for(healthy, "the serving worker's /healthz")
+
+        log("phase 2: run 2 — resume; actors act over HTTP ...")
+        n_before = len(metrics_lines(metrics))
+        served_before = metrics_lines(metrics)[-1].get(
+            "decoupled/serving_actions_total", 0
+        )
+        learner = launch_learner(["--run", run_id])
+        first_line = wait_for(
+            lambda: (
+                metrics_lines(metrics)[n_before]
+                if len(metrics_lines(metrics)) > n_before else None
+            ),
+            "run 2's first epoch line",
+        )
+        if first_line.get(
+            "decoupled/serving_actions_total", 0
+        ) <= served_before:
+            fail("run 2's actors never acted through the serving worker")
+        log("phase 2: SIGKILL the serving worker + SIGTERM the learner "
+            "mid-collection of the next epoch ...")
+        worker.send_signal(signal.SIGKILL)
+        worker.wait(timeout=30)
+        learner.send_signal(signal.SIGTERM)
+        rc = learner.wait(timeout=600)
+        if rc != 75:
+            fail(f"run 2 exited rc={rc}, expected the requeue code 75")
+        log("phase 2: learner exited 75 (requeue); run 3 — resume "
+            "degraded to completion ...")
+
+        learner = launch_learner(["--run", run_id])
+        rc = learner.wait(timeout=600)
+        if rc != 0:
+            fail(f"run 3 exited rc={rc}")
+
+        final = metrics_lines(metrics)[-1]
+        for key in ("loss_q", "loss_pi", "reward"):
+            if not _finite(final.get(key)):
+                fail(f"final {key} not finite: {final.get(key)}")
+        # Conservation over the WHOLE run, across BOTH restarts: every
+        # accepted transition was drained, dropped-by-policy, or is
+        # still staged (depth) — none silently lost.
+        staged = final["decoupled/staged_total"]
+        accounted = (
+            final["decoupled/drained_total"]
+            + final["decoupled/dropped_stale_total"]
+            + final["decoupled/dropped_backpressure_total"]
+            + final["decoupled/staging_depth"]
+        )
+        if staged != accounted:
+            fail(f"staging conservation violated: staged={staged} vs "
+                 f"accounted={accounted}")
+        if final["decoupled/actor_lag_max"] > MAX_ACTOR_LAG:
+            fail(f"recorded lag {final['decoupled/actor_lag_max']} "
+                 f"exceeds --max-actor-lag {MAX_ACTOR_LAG}")
+        if final["decoupled/degradations_total"] < 2:
+            fail("expected >= 2 degradations (cold start + worker kill)")
+        if final["decoupled/serving_actions_total"] <= 0:
+            fail("expected serving-plane actions while the worker lived")
+        log(
+            "phase 2 OK: staged=%d drained=%d dropped_stale=%d "
+            "depth=%d lag_max=%s served=%d fallbacks=%d "
+            "degradations=%d" % (
+                staged, final["decoupled/drained_total"],
+                final["decoupled/dropped_stale_total"],
+                final["decoupled/staging_depth"],
+                final["decoupled/actor_lag_max"],
+                final["decoupled/serving_actions_total"],
+                final["decoupled/fallback_actions_total"],
+                final["decoupled/degradations_total"],
+            )
+        )
+    finally:
+        for proc in (learner, worker):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+
+def _finite(v):
+    try:
+        return v is not None and abs(float(v)) < float("inf")
+    except (TypeError, ValueError):
+        return False
+
+
+def main():
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="decouple_smoke_") as td:
+        root = Path(td)
+        phase_bitwise(root / "bitwise")
+        phase_chaos(root / "chaos")
+    log("ALL OK: both role kills survived; zero accepted transitions "
+        "lost; replay bitwise across the learner resume; staleness "
+        "bounded by the lag knob")
+
+
+if __name__ == "__main__":
+    main()

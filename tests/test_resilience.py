@@ -478,3 +478,40 @@ def test_checkpoint_meta_carries_resume_state(tmp_path):
         jax.random.key_data(jax.random.key(0))
     ).shape
     json.dumps(meta)  # the whole meta stays JSON-serializable
+
+
+# ---------------------------------------- path 5: lossy actor<->serving link
+
+
+def test_lossy_link_degrades_actor_and_recovery_rehomes(tmp_path):
+    """The decoupled plane's link fault (resilience/faultinject.py
+    LossyLink): a dropped-then-recovering actor<->serving link must
+    degrade acting to the local snapshot WITHOUT stalling the env loop,
+    keep training, and re-home when the link heals — the decoupled
+    twin of the env-worker-death path (ISSUE 10; the full matrix lives
+    in tests/test_decoupled.py and `make decouple-smoke`)."""
+    from torch_actor_critic_tpu.decoupled import DecoupledTrainer
+    from torch_actor_critic_tpu.resilience.faultinject import LossyLink
+
+    cfg = SACConfig(**{**TINY, "epochs": 2, "decoupled": True})
+    tr = DecoupledTrainer(
+        "Pendulum-v1", cfg, mesh=make_mesh(dp=1),
+        checkpointer=None, seed=7,
+    )
+    # Every serving call from lockstep step 15 to ~step 30 dies at the
+    # link; the actor's probe cadence re-homes it before the run ends.
+    link = LossyLink(tr.client).drop_next(5)
+    tr.pool = FaultyEnvPool(tr.pool).call_at(
+        15, lambda: setattr(tr.actor, "client", link)
+    )
+    try:
+        metrics = tr.train()
+        assert np.isfinite(metrics["loss_q"])
+        assert link.drops_injected == 5
+        assert tr.actor.degradations_total >= 1
+        assert tr.actor.fallback_actions_total >= 1
+        assert tr.actor.rehomes_total >= 1
+        assert not tr.actor.degraded  # healed link, re-homed actor
+        assert tr.staging.conservation_holds()
+    finally:
+        tr.close()

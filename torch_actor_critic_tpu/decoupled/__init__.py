@@ -1,0 +1,22 @@
+"""Decoupled actor/learner plane (ROADMAP item 5, docs/RESILIENCE.md
+"Decoupled-plane failure modes"): actors act through the serving
+plane, transitions flow through a bounded staging buffer with a
+staleness admission gate, and the learner publishes epochs via the
+validated hot-reload — every link fault-injected and recovery-proven
+(``make decouple-smoke``)."""
+
+from torch_actor_critic_tpu.decoupled.actor import ActorWorker
+from torch_actor_critic_tpu.decoupled.learner import DecoupledTrainer
+from torch_actor_critic_tpu.decoupled.staging import (
+    StagedTransition,
+    StagingBuffer,
+    StagingUnavailable,
+)
+
+__all__ = [
+    "ActorWorker",
+    "DecoupledTrainer",
+    "StagedTransition",
+    "StagingBuffer",
+    "StagingUnavailable",
+]

@@ -1,0 +1,396 @@
+"""Decoupled learner: the hardened Trainer loop over the serving plane.
+
+:class:`DecoupledTrainer` keeps every hardened piece of the host
+:class:`~torch_actor_critic_tpu.sac.trainer.Trainer` — divergence
+sentinel, preemption guard, telemetry phases, diagnostics, cost
+attribution, bitwise resume — and replaces only the DATA PATH through
+the subclass seams (ROADMAP item 5, Podracer arXiv:2104.06272 /
+TorchBeast arXiv:1910.03552):
+
+- **Acting** goes through a :class:`~torch_actor_critic_tpu.serve.
+  server.PolicyClient` (in-process registry+batcher built here, or
+  HTTP at ``config.serve_url``) via an :class:`~torch_actor_critic_tpu
+  .decoupled.actor.ActorWorker` — bounded retry, graceful degradation
+  to the learner's own param mirror (staleness-stamped), re-homing.
+- **Staging** is the bounded :class:`~torch_actor_critic_tpu.decoupled
+  .staging.StagingBuffer`: every transition tagged with the serving
+  response's ``(generation, epoch)``, drained in fixed windows through
+  the bounded-staleness admission gate into the unchanged replay/
+  update path.
+- **Publishing**: each sentinel-validated epoch swaps the new actor
+  params into the registry through the PR-5 validated hot-reload (a
+  non-finite publish is *rejected* and actors keep acting on
+  last-good); in ``serve_url`` mode the epoch checkpoint IS the
+  publish — the remote worker's poller picks it up.
+- **Fault tolerance**: checkpoints additionally carry the staged-but-
+  undrained transitions (the ``arrays`` item), the staging counters +
+  lag histogram, and the batcher's sampled-action PRNG key — so a
+  SIGTERM on the learner (PreemptionGuard, requeue code 75) loses no
+  accepted transition and the replay stream is **bitwise** across the
+  resume, while remote actors idle-spin against the paused staging
+  buffer and reconnect (proven in tests/test_decoupled.py and
+  ``make decouple-smoke``).
+
+Deployment story (docs/SERVING.md "Training feeds serving"): the same
+registry/batcher/client stack serves production traffic and training
+actors; a training cluster's learner publishes into the serving fleet
+its actors read from.
+"""
+
+from __future__ import annotations
+
+import logging
+import typing as t
+
+import jax
+import numpy as np
+
+from torch_actor_critic_tpu.decoupled.actor import ActorWorker
+from torch_actor_critic_tpu.decoupled.staging import StagingBuffer
+from torch_actor_critic_tpu.sac.trainer import Trainer
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DecoupledTrainer"]
+
+
+class DecoupledTrainer(Trainer):
+    """Trainer whose actors act through the serving plane.
+
+    Accepts every :class:`Trainer` argument; ``client`` injects a
+    pre-built :class:`PolicyClient` (tests wrap it in the lossy-link
+    fault injector), otherwise ``config.serve_url`` selects HTTP mode
+    and the default builds a co-located in-process serving plane
+    (registry + micro-batcher) that doubles as this process's policy
+    service — ``metrics_snapshot`` plugs into a ``PolicyServer``'s
+    ``extra_snapshot`` to put staging/staleness on ``/metrics``.
+    """
+
+    def __init__(self, *args, client=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        cfg = self.config
+        self.staging = StagingBuffer(
+            capacity=cfg.resolved_staging_capacity,
+            policy=cfg.staging_policy,
+            max_lag=cfg.max_actor_lag,
+        )
+        self._published_generation = 0
+        self._published_epoch: int | None = None
+        self._publish_rejected_total = 0
+        self._collecting = False
+        self._last_tag: t.Tuple[int, int | None] = (0, None)
+        self.registry = None
+        self.batcher = None
+        self._owns_plane = False
+        if client is not None:
+            self.client = client
+        elif cfg.serve_url:
+            from torch_actor_critic_tpu.serve.server import PolicyClient
+
+            self.client = PolicyClient(
+                url=cfg.serve_url, retries=1, backoff_s=0.1
+            )
+        else:
+            self._build_inprocess_plane()
+        self.actor = ActorWorker(
+            self.client,
+            self.staging,
+            fallback=self._local_fallback,
+            act_timeout_s=cfg.actor_timeout_s,
+            probe_every=4,
+        )
+
+    def _build_inprocess_plane(self):
+        """Co-located serving plane: one registry slot holding this
+        learner's actor, behind a real micro-batcher — the exact stack
+        ``serve.py`` runs, so "training feeds serving" is one code
+        path whether the fleet is in-process or remote."""
+        from torch_actor_critic_tpu.serve.batcher import MicroBatcher
+        from torch_actor_critic_tpu.serve.registry import ModelRegistry
+        from torch_actor_critic_tpu.serve.server import PolicyClient
+
+        serve_batch = max(self.n_envs, 1)
+        self.registry = ModelRegistry()
+        self.registry.register(
+            "default",
+            self.sac.actor_def,
+            self.pool.obs_spec,
+            params=self._fetch_params_single_transfer(),
+            max_batch=serve_batch,
+            warmup=True,
+        )
+        self.batcher = MicroBatcher(
+            self.registry, max_batch=serve_batch, seed=self.seed + 7919
+        )
+        self.client = PolicyClient(
+            self.registry, self.batcher, retries=1, backoff_s=0.05
+        )
+        self._owns_plane = True
+
+    # ------------------------------------------------------------- acting
+
+    def _local_fallback(self, obs, deterministic):
+        """Degraded-mode acting: the learner-local param path the base
+        trainer uses (host mirror, one transfer per window), stamped
+        with the last PUBLISHED generation/epoch — what degraded
+        transitions honestly are to the staging gate."""
+        actions = Trainer._policy_actions(self, obs, deterministic)
+        return actions, self._published_generation, self._published_epoch
+
+    def _policy_actions(self, obs_batch, deterministic=False) -> np.ndarray:
+        if deterministic or not self._collecting:
+            # Evaluation (and any deterministic rollout) reads the
+            # current learner params directly, exactly as lockstep.
+            return super()._policy_actions(obs_batch, deterministic)
+        actions, generation, epoch, _ = self.actor.act(
+            obs_batch, deterministic=False
+        )
+        self._last_tag = (generation, epoch)
+        return np.asarray(actions)
+
+    def train(self, render: bool = False) -> dict:
+        self._collecting = True
+        try:
+            return super().train(render)
+        finally:
+            self._collecting = False
+
+    # ------------------------------------------------------------ staging
+
+    def _canonical_transition(self, transition: tuple) -> tuple:
+        """Pin the staged dtypes to the env spec so checkpointed
+        staging arrays restore against a shape/dtype-stable abstract
+        tree regardless of what a normalizer upcast."""
+        obs, actions, rewards, next_obs, done = transition
+        spec = self.pool.obs_spec
+
+        def cast(x, s):
+            return np.asarray(x, dtype=s.dtype)
+
+        return (
+            jax.tree_util.tree_map(cast, obs, spec),
+            np.asarray(actions, np.float32),
+            np.asarray(rewards, np.float32),
+            jax.tree_util.tree_map(cast, next_obs, spec),
+            np.asarray(done, np.float32),
+        )
+
+    def _stage(self, staging, transition) -> None:
+        # `staging` (the base loop's host list) is unused: transitions
+        # live in the bounded buffer, under its backpressure policy.
+        generation, epoch = self._last_tag
+        self.staging.put(
+            self._canonical_transition(transition),
+            generation=generation,
+            epoch=epoch,
+        )
+
+    def _drain_window(self, staging):
+        entries = self.staging.pop_window(
+            self.config.update_every, current_epoch=self._epoch
+        )
+        if entries is None:
+            return None
+        return self._build_chunk([e.transition for e in entries])
+
+    # --------------------------------------------------------- publishing
+
+    def _publish_epoch(self, epoch: int, saved: bool) -> None:
+        if self.registry is not None:
+            try:
+                generation = self.registry.swap(
+                    "default",
+                    self._fetch_params_single_transfer(),
+                    epoch=int(epoch),
+                )
+            except ValueError as e:
+                # PR-5 validated hot-reload: a non-finite publish is
+                # rejected; the slot keeps serving last-good and actors
+                # never see the poison (docs/SERVING.md).
+                self._publish_rejected_total += 1
+                logger.warning(
+                    "epoch %d publish REJECTED (%s); actors keep "
+                    "acting on generation %d (epoch %s)",
+                    epoch, e, self._published_generation,
+                    self._published_epoch,
+                )
+                return
+            self._published_generation += 1
+            self._published_epoch = int(epoch)
+            logger.debug(
+                "published epoch %d as generation %d",
+                epoch, generation,
+            )
+        elif saved:
+            # Remote serving: the epoch checkpoint IS the publish — the
+            # worker's hot-reload poller validates and swaps it.
+            self._published_generation += 1
+            self._published_epoch = int(epoch)
+
+    def _epoch_boundary_hook(
+        self, epoch, sentinel_ok, saved, last_metrics, rec
+    ) -> None:
+        if sentinel_ok:
+            self._publish_epoch(epoch, saved)
+        snap = self.staging.snapshot()
+        actor = self.actor.stats()
+        lag = snap["actor_lag"]
+        last_metrics.update({
+            "decoupled/staged_total": snap["staged_total"],
+            "decoupled/drained_total": snap["drained_total"],
+            "decoupled/dropped_stale_total": snap["dropped_stale_total"],
+            "decoupled/dropped_backpressure_total":
+                snap["dropped_backpressure_total"],
+            "decoupled/shed_total": snap["shed_total"],
+            "decoupled/blocked_total": snap["blocked_total"],
+            "decoupled/staging_depth": snap["depth"],
+            "decoupled/actor_lag_mean": lag.get("actor_lag_mean", 0.0),
+            "decoupled/actor_lag_p95": lag.get("actor_lag_p95", 0.0),
+            "decoupled/actor_lag_max": lag.get("actor_lag_max", 0.0),
+            "decoupled/serving_actions_total":
+                actor["serving_actions_total"],
+            "decoupled/fallback_actions_total":
+                actor["fallback_actions_total"],
+            "decoupled/degradations_total": actor["degradations_total"],
+            "decoupled/rehomes_total": actor["rehomes_total"],
+            "decoupled/degraded": float(actor["degraded"]),
+            "decoupled/published_generation": self._published_generation,
+            "decoupled/publish_rejected_total":
+                self._publish_rejected_total,
+            "decoupled/client_retries_total": self.client.retries_total,
+        })
+        # Lag drift is a leading indicator of a sick actor↔serving
+        # link (a degraded fleet keeps feeding ever-staler data until
+        # the gate bites): route it through the early-warning monitor
+        # into the sentinel, like the in-graph diagnostics.
+        if self.monitor is not None:
+            for w in self.monitor.update({
+                "decoupled/actor_lag_mean":
+                    lag.get("actor_lag_mean", 0.0),
+            }):
+                logger.warning(
+                    "early warning %s: %s=%.4g vs baseline %.4g "
+                    "(deviation envelope %.4g) — actor staleness "
+                    "drifting, see docs/RESILIENCE.md",
+                    w["kind"], w["key"], w["value"], w["baseline"],
+                    w["spread"],
+                )
+                if self.sentinel is not None:
+                    self.sentinel.note_warning(w["kind"])
+                if rec is not None:
+                    rec.event("early_warning", epoch=int(epoch), **w)
+        if rec is not None:
+            rec.event(
+                "decoupled", epoch=int(epoch), staging=snap,
+                actor=actor,
+                published_generation=self._published_generation,
+                publish_rejected_total=self._publish_rejected_total,
+            )
+
+    # --------------------------------------------------------- checkpoint
+
+    def _checkpoint_extra(self, step: int) -> dict:
+        extra = super()._checkpoint_extra(step)
+        dec = {
+            "staging": self.staging.meta_state(),
+            "published_generation": self._published_generation,
+            "published_epoch": self._published_epoch,
+            "publish_rejected_total": self._publish_rejected_total,
+            "actor": self.actor.stats(),
+        }
+        if self.batcher is not None:
+            # The serving plane's sampled-action PRNG stream is part of
+            # the run: resume continues it bitwise.
+            dec["batcher_key"] = self.batcher.export_key()
+        extra["decoupled"] = dec
+        return extra
+
+    def _checkpoint_arrays(self):
+        return self.staging.export_arrays()
+
+    def _staging_abstract(self, count: int) -> dict:
+        n = self.n_envs
+        spec = self.pool.obs_spec
+
+        def zeros(s):
+            return np.zeros((count, n) + tuple(s.shape), s.dtype)
+
+        return {
+            "obs": jax.tree_util.tree_map(zeros, spec),
+            "actions": np.zeros((count, n, self.pool.act_dim), np.float32),
+            "rewards": np.zeros((count, n), np.float32),
+            "next_obs": jax.tree_util.tree_map(zeros, spec),
+            "done": np.zeros((count, n), np.float32),
+            "generation": np.zeros((count,), np.int64),
+            "epoch": np.zeros((count,), np.int64),
+        }
+
+    def _checkpoint_abstract_arrays(self, meta_probe: dict):
+        dec = (meta_probe or {}).get("decoupled") or {}
+        count = int((dec.get("staging") or {}).get("count", 0))
+        if count == 0:
+            return None
+        return self._staging_abstract(count)
+
+    def _restore_extras(self, meta: dict, arrays) -> None:
+        dec = meta.get("decoupled") or {}
+        if dec.get("staging"):
+            self.staging.load_meta(dec["staging"])
+        if arrays is not None:
+            restored = self.staging.import_arrays(arrays)
+            logger.info(
+                "restored %d staged transitions from the checkpoint "
+                "(zero accepted transitions lost across the restart)",
+                restored,
+            )
+        self._published_generation = int(
+            dec.get("published_generation", 0)
+        )
+        self._published_epoch = dec.get("published_epoch")
+        self._publish_rejected_total = int(
+            dec.get("publish_rejected_total", 0)
+        )
+        self.actor.load_stats(dec.get("actor") or {})
+        if self.batcher is not None and dec.get("batcher_key"):
+            self.batcher.import_key(dec["batcher_key"])
+        if self.registry is not None:
+            # Refresh the co-located slot to the restored weights so
+            # serving resumes from the checkpointed policy, not the
+            # fresh-init params it was registered with.
+            try:
+                self.registry.swap(
+                    "default",
+                    self._fetch_params_single_transfer(),
+                    epoch=meta.get("epoch"),
+                )
+            except ValueError as e:  # pragma: no cover — a restored
+                # checkpoint is sentinel-validated; belt and braces
+                logger.warning(
+                    "restored params rejected by the serving "
+                    "sentinel (%s); slot keeps its current params", e,
+                )
+
+    # ------------------------------------------------------- introspection
+
+    def metrics_snapshot(self) -> dict:
+        """``/metrics``-mergeable view of the decoupled plane — pass as
+        ``PolicyServer(extra_snapshot=...)`` so a co-located server
+        reports staging depth, backpressure counts and the actor-lag
+        histogram next to its serving metrics."""
+        return {
+            "decoupled": {
+                "staging": self.staging.snapshot(),
+                "actor": self.actor.stats(),
+                "published_generation": self._published_generation,
+                "published_epoch": self._published_epoch,
+                "publish_rejected_total": self._publish_rejected_total,
+            }
+        }
+
+    def close(self):
+        if self._owns_plane:
+            try:
+                self.batcher.close()
+            finally:
+                self.registry.close()
+        super().close()

@@ -36,6 +36,11 @@ DEFAULT_RULES: t.Tuple[t.Tuple[str, str, str], ...] = (
     ("grad_spike", "diag/grad_norm_pi", "high"),
     ("entropy_collapse", "entropy", "low"),
     ("q_bias_drift", "diag/q_bias", "shift"),
+    # Decoupled plane (decoupled/): mean per-transition generation lag
+    # drifting upward is the leading indicator of a sick actor↔serving
+    # link — degraded actors feed ever-staler data until the admission
+    # gate starts dropping it. Key absent outside decoupled runs.
+    ("actor_lag_drift", "decoupled/actor_lag_mean", "high"),
 )
 
 

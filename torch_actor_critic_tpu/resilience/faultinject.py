@@ -52,6 +52,7 @@ import numpy as np
 __all__ = [
     "FaultyEnvPool",
     "FaultyEngine",
+    "LossyLink",
     "kill_env_worker",
     "make_flaky",
     "corrupt_checkpoint",
@@ -185,6 +186,86 @@ class FaultyEngine:
 
     def __getattr__(self, name: str):
         return getattr(self._engine, name)
+
+
+class LossyLink:
+    """Protocol-transparent lossy/slow network link between a policy
+    client and its server — the actor↔serving fault injector for the
+    decoupled plane (docs/RESILIENCE.md "Decoupled-plane failure
+    modes").
+
+    Wraps anything with an ``act(...)`` method (a
+    :class:`~torch_actor_critic_tpu.serve.server.PolicyClient` in
+    either transport mode, a :class:`~torch_actor_critic_tpu.serve.
+    batcher.MicroBatcher`, a whole
+    :class:`~torch_actor_critic_tpu.serve.fleet.EngineFleet`) and, per
+    call, injects configurable **latency** (``latency_s``, via the
+    injectable ``sleep``) and **drops** — a dropped call raises
+    ``ConnectionError`` (an ``OSError``, exactly what a real dead link
+    surfaces through urllib), so the caller's degradation path runs,
+    not a special test path. Drops are either probabilistic
+    (``drop_rate`` with a seedable ``rng``) or exactly scheduled
+    (:meth:`drop_next` — the deterministic mode the step-synchronized
+    tests use). Usable standalone::
+
+        link = LossyLink(client, latency_s=0.05, drop_rate=0.3,
+                         rng=random.Random(0))
+        actor = ActorWorker(link, staging, fallback=...)
+
+    Counting is on calls through THIS wrapper (``calls_total`` /
+    ``drops_injected``) so tests can assert exactly which calls died.
+    """
+
+    def __init__(
+        self,
+        client: t.Any,
+        drop_rate: float = 0.0,
+        latency_s: float = 0.0,
+        rng=None,
+        sleep: t.Callable[[float], None] = None,
+    ):
+        if not 0.0 <= drop_rate <= 1.0:
+            raise ValueError(f"drop_rate must be in [0, 1], got {drop_rate}")
+        import random as _random
+        import time as _time
+
+        self._client = client
+        self.drop_rate = float(drop_rate)
+        self.latency_s = float(latency_s)
+        self._rng = rng if rng is not None else _random.Random()
+        self._sleep = sleep if sleep is not None else _time.sleep
+        self._drop_left = 0
+        self.calls_total = 0
+        self.drops_injected = 0
+        self.latency_injected_s = 0.0
+
+    def drop_next(self, n: int) -> "LossyLink":
+        """Deterministically drop the next ``n`` calls (cumulative with
+        any already scheduled; takes precedence over ``drop_rate``)."""
+        self._drop_left += int(n)
+        return self
+
+    def act(self, *args, **kwargs):
+        self.calls_total += 1
+        if self.latency_s > 0.0:
+            self.latency_injected_s += self.latency_s
+            self._sleep(self.latency_s)
+        dropped = False
+        if self._drop_left > 0:
+            self._drop_left -= 1
+            dropped = True
+        elif self.drop_rate > 0.0 and self._rng.random() < self.drop_rate:
+            dropped = True
+        if dropped:
+            self.drops_injected += 1
+            raise ConnectionError(
+                "injected lossy link: request dropped in flight "
+                f"(call {self.calls_total})"
+            )
+        return self._client.act(*args, **kwargs)
+
+    def __getattr__(self, name: str):
+        return getattr(self._client, name)
 
 
 def nan_params(params: t.Any, fraction_leaf: int = 0) -> t.Any:

@@ -573,6 +573,9 @@ class Trainer:
                 self.mesh, sp=self.dp.effective_sp,
             )
         self.start_epoch = 0
+        # Current training epoch, maintained by the train loop (the
+        # decoupled staging gate reads it as the staleness reference).
+        self._epoch = 0
 
     # ------------------------------------------------------------ helpers
 
@@ -626,6 +629,34 @@ class Trainer:
             next_states=stack_field(3),
             done=stack_field(4).astype(np.float32),
         )
+
+    # Staging seams (overridden by decoupled/learner.py, where the host
+    # list becomes a bounded StagingBuffer with backpressure and the
+    # bounded-staleness admission gate): the base trainer's lockstep
+    # semantics are exactly "append, then drain a full window".
+
+    def _stage(self, staging: t.List[tuple], transition: tuple) -> None:
+        """Admit one batched transition into the staging path."""
+        staging.append(transition)
+
+    def _drain_window(self, staging: t.List[tuple]):
+        """Drain one update window into a local chunk, or None when the
+        staging path cannot fill a fixed-size window this boundary (the
+        decoupled gate may have dropped stale transitions; the window
+        is then skipped — chunk shapes, and the jit cache, never
+        vary). The base trainer always has exactly one window staged."""
+        chunk = self._build_chunk(staging)
+        del staging[:]
+        return chunk
+
+    def _epoch_boundary_hook(
+        self, epoch: int, sentinel_ok: bool, saved: bool,
+        last_metrics: dict, rec,
+    ) -> None:
+        """Subclass seam, called once per epoch after the sentinel and
+        checkpoint save and before metrics logging (the decoupled
+        trainer publishes the epoch to the serving registry and merges
+        staging/degradation metrics here)."""
 
     # ------------------------------------------------------ cost accounting
 
@@ -704,6 +735,25 @@ class Trainer:
             + 10_000 * (self._env_offset + i)
         )
 
+    def _checkpoint_extra(self, step: int) -> dict:
+        """The JSON metadata saved beside the arrays; subclasses extend
+        (the decoupled trainer adds staging counters and the serving
+        plane's PRNG state, decoupled/learner.py)."""
+        return {
+            "config": self.config.to_json(),
+            "normalizer": self.normalizer.state_dict(),
+            "step": int(step),
+            "act_key": np.asarray(
+                jax.random.key_data(self._act_key)
+            ).astype(np.uint32).tolist(),
+        }
+
+    def _checkpoint_arrays(self):
+        """Extra array pytree for the checkpoint ``arrays`` item (the
+        decoupled trainer persists its staged-but-undrained transitions
+        here); None = no item."""
+        return None
+
     def _save_checkpoint(self, epoch: int, step: int, wait: bool = False):
         """One checkpoint = TrainState + buffer + the host-loop state a
         TrainState cannot carry: the lockstep step counter (warmup and
@@ -714,15 +764,9 @@ class Trainer:
             epoch,
             self.state,
             self.buffer,
-            extra={
-                "config": self.config.to_json(),
-                "normalizer": self.normalizer.state_dict(),
-                "step": int(step),
-                "act_key": np.asarray(
-                    jax.random.key_data(self._act_key)
-                ).astype(np.uint32).tolist(),
-            },
+            extra=self._checkpoint_extra(step),
             wait=wait,
+            arrays=self._checkpoint_arrays(),
         )
 
     def _load_checkpoint(
@@ -746,12 +790,19 @@ class Trainer:
                     f"{self.config.algorithm!r}; pass --algorithm "
                     f"{saved_algo} to resume it"
                 )
-        state, buffer, meta = self.checkpointer.restore(
+        abstract_arrays = self._checkpoint_abstract_arrays(meta_probe)
+        out = self.checkpointer.restore(
             jax.tree_util.tree_map(lambda x: x, self.state),
             self.buffer if include_buffer else None,
             epoch=epoch,
             meta_probe=meta_probe,
+            abstract_arrays=abstract_arrays,
         )
+        if abstract_arrays is None:
+            state, buffer, meta = out
+            arrays = None
+        else:
+            state, buffer, meta, arrays = out
         self.state = state
         self._host_params = None  # mirror is stale
         if buffer is not None:
@@ -765,7 +816,19 @@ class Trainer:
             if self.config.host_actor:
                 key = jax.device_put(key, self._host_device)
             self._act_key = key
+        self._restore_extras(meta, arrays)
         return meta
+
+    def _checkpoint_abstract_arrays(self, meta_probe: dict):
+        """Abstract pytree for the checkpoint's extra ``arrays`` item,
+        derived from the metadata probe (the decoupled trainer sizes
+        its staged-transition restore from it); None = not requested."""
+        return None
+
+    def _restore_extras(self, meta: dict, arrays) -> None:
+        """Subclass seam: apply checkpoint metadata/arrays beyond the
+        base trainer's (decoupled staging contents, serving-plane PRNG,
+        publish counters — decoupled/learner.py)."""
 
     def _rollback(self) -> int:
         """Divergence recovery: restore the newest (sentinel-validated)
@@ -848,6 +911,7 @@ class Trainer:
 
         t_epoch = time.time()
         for e in epoch_iter:
+            self._epoch = e
             if rec is not None:
                 rec.epoch_begin(e)
             losses_q, losses_pi = [], []
@@ -880,14 +944,15 @@ class Trainer:
                 # Stage whole batched pytrees. next_obs is copied because
                 # episode resets overwrite its rows in place below; obs
                 # is never mutated after this point.
-                staging.append(
+                self._stage(
+                    staging,
                     (
                         obs,
                         actions,
                         rewards,
                         jax.tree_util.tree_map(np.array, next_obs),
                         done_for_buffer,
-                    )
+                    ),
                 )
 
                 if render and self._render_ok and is_coordinator():
@@ -935,9 +1000,14 @@ class Trainer:
                 # --- device window: push or push+update (ref :273-283) ---
                 window_full = (step + 1) % cfg.update_every == 0
                 if window_full:
-                    local_chunk = self._build_chunk(staging)
+                    local_chunk = self._drain_window(staging)
                     if rec is not None:
                         rec.lap(_PH_STAGE)
+                # A None chunk (decoupled only: the admission gate
+                # dropped staged transitions below one fixed-size
+                # window) skips this boundary's device work entirely —
+                # the leftover transitions ride into the next window.
+                if window_full and local_chunk is not None:
                     if self.population > 1:
                         # Leading axis is the member axis; the learner
                         # shards it over dp itself (no mesh resharding).
@@ -946,7 +1016,6 @@ class Trainer:
                         chunk = shard_chunk_from_local(
                             local_chunk, self.mesh, sp=self.dp.effective_sp,
                         )
-                    staging = []
                     if rec is not None:
                         rec.lap(_PH_PLACE)
                     if step > cfg.update_after:
@@ -1239,6 +1308,13 @@ class Trainer:
             last_metrics["save_s"] = round(time.perf_counter() - t_save, 4)
             if rec is not None:
                 rec.lap(_PH_CKPT)
+
+            # Decoupled-plane boundary work (no-op in the base class):
+            # publish this epoch's params to the serving registry and
+            # merge staging/degradation metrics before they are logged.
+            self._epoch_boundary_hook(
+                e, sentinel_ok, saved_this_epoch, last_metrics, rec
+            )
 
             # Logged after the save so sentinel_s/save_s land in the
             # epoch that paid them.

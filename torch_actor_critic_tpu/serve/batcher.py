@@ -89,10 +89,17 @@ __all__ = ["MicroBatcher", "ActResult"]
 
 class ActResult(t.NamedTuple):
     """One resolved ``act`` call: the action rows (leading axis matches
-    the request's) and the model generation that computed them."""
+    the request's), the model generation that computed them, and the
+    training epoch those params were published at (``None`` for params
+    that never came from a checkpoint/publish — e.g. directly-seeded
+    test slots). Decoupled actors stamp every transition with these two
+    (docs/RESILIENCE.md "Decoupled-plane failure modes"): the epoch is
+    the durable staleness key (it survives a serving-worker restart,
+    which resets the per-process generation counter)."""
 
     action: np.ndarray
     generation: int
+    epoch: int | None = None
 
 
 class _Request:
@@ -236,7 +243,7 @@ class MicroBatcher:
                 return
             res: ActResult = f.result()
             action = res.action if batched else res.action[0]
-            outer.set_result(ActResult(action, res.generation))
+            outer.set_result(ActResult(action, res.generation, res.epoch))
 
         req.future.add_done_callback(_copy)
         with self._nonempty:
@@ -509,6 +516,42 @@ class MicroBatcher:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def _slot_epoch(self, slot_name: str) -> int | None:
+        """The slot's published training epoch, when the registry
+        exposes one (``ModelRegistry.epoch_of``). Read next to
+        ``acquire`` rather than inside it so the registry interface
+        stays duck-type compatible with older views; a swap landing
+        between the two reads can mis-stamp at most one group by one
+        publish — and the decoupled driver acts and publishes on one
+        thread, where the race cannot occur."""
+        epoch_of = getattr(self.registry, "epoch_of", None)
+        if epoch_of is None:
+            return None
+        try:
+            return epoch_of(slot_name)
+        except Exception:  # noqa: BLE001 — stamping must never fail a group
+            return None
+
+    # --------------------------------------------------- sampled-key state
+
+    def export_key(self) -> list:
+        """The sampled-action PRNG key as raw uint32 data (JSON-ready).
+        The decoupled learner checkpoints this next to the trainer's
+        acting key so a resumed run's exploration stream continues
+        bitwise through the serving plane (docs/RESILIENCE.md)."""
+        with self._lock:
+            return (
+                np.asarray(jax.random.key_data(self._key))
+                .astype(np.uint32).tolist()
+            )
+
+    def import_key(self, data) -> None:
+        """Restore the sampled-action PRNG key from :meth:`export_key`
+        output."""
+        key = jax.random.wrap_key_data(np.asarray(data, dtype=np.uint32))
+        with self._lock:
+            self._key = key
+
     def _run_group(self, group: t.List[_Request]):
         slot_name = group[0].slot
         breaker = self.registry.breaker(slot_name)
@@ -533,6 +576,7 @@ class MicroBatcher:
             return
         try:
             engine, params, generation = self.registry.acquire(slot_name)
+            epoch = self._slot_epoch(slot_name)
             det = group[0].deterministic
             obs = group[0].obs
             if len(group) > 1:
@@ -575,7 +619,7 @@ class MicroBatcher:
             lo = 0
             for r in group:
                 r.future.set_result(
-                    ActResult(action[lo:lo + r.rows], generation)
+                    ActResult(action[lo:lo + r.rows], generation, epoch)
                 )
                 self.metrics.record_done((done_t - r.t_enq) * 1e3)
                 lo += r.rows

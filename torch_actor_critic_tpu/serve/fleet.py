@@ -108,6 +108,12 @@ class _ReplicaRegistry:
                 self._params[name] = (generation, placed)
             return engine, self._params[name][1], generation
 
+    def epoch_of(self, name: str = "default") -> int | None:
+        """Epoch stamping delegates to the shared registry — every
+        replica serves the same published params, so they share one
+        staleness key."""
+        return self._base.epoch_of(name)
+
     def breaker(self, name: str = "default") -> CircuitBreaker | None:
         base = self._base.breaker(name)
         if base is None:

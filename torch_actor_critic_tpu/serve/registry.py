@@ -208,6 +208,18 @@ class ModelRegistry:
             params, generation, _ = slot.state
         return slot.engine, params, generation
 
+    def epoch_of(self, name: str = "default") -> int | None:
+        """The training epoch the slot's current params were published
+        at (``None`` for directly-seeded slots that never saw a
+        checkpoint or publish). The batcher stamps every response with
+        this (:class:`~torch_actor_critic_tpu.serve.batcher.ActResult`
+        ``.epoch``) so decoupled actors can tag transitions with a
+        staleness key that survives serving-process restarts — the
+        generation counter is per-process, the epoch is durable."""
+        slot = self._slot(name)
+        with slot.lock:
+            return slot.state[2]
+
     def breaker(self, name: str = "default") -> CircuitBreaker | None:
         """The slot's circuit breaker (None only for foreign slots —
         every registered slot has one)."""

@@ -71,17 +71,28 @@ class PolicyClient:
 
     **HTTP mode** (``PolicyClient(url="http://host:port")``): the
     remote path actors and smoke harnesses use against a worker or a
-    fleet router. ``act`` gains **retry with jittered backoff** that
-    honors the ``Retry-After`` header the overload layer already emits
-    on 429/503 (docs/SERVING.md): on a retryable rejection the client
-    sleeps ``max(Retry-After, backoff·2^attempt)`` plus up to 25%
+    fleet router.
+
+    **Retry semantics are transport-agnostic** (the decoupled
+    actor/learner contract, docs/RESILIENCE.md): in BOTH modes ``act``
+    retries rejected requests with **jittered backoff** honoring the
+    server's own retry hint — the ``Retry-After`` header on the wire,
+    the structured :class:`~torch_actor_critic_tpu.serve.admission.
+    ShedError` ``retry_after_s`` in-process: on a retryable rejection
+    the client sleeps ``max(hint, backoff·2^attempt)`` plus up to 25%
     jitter (decorrelates a herd of clients all told "retry in 1s"),
-    for at most ``retries`` retry attempts — and **deadline-aware**:
-    the ``timeout`` passed to ``act`` is the caller's total budget, so
-    a retry that could not complete before the deadline is never
-    started and the last rejection is raised instead. 4xx client
-    errors and 5xx server faults are never retried (retrying a
-    malformed request or a broken engine is not backoff's job).
+    for at most ``retries`` retry attempts — and is
+    **deadline-aware**: the ``timeout`` passed to ``act`` is the
+    caller's total budget, so a retry that could not complete before
+    the deadline is never started and the last rejection (its
+    ``ShedError`` taxonomy preserved) is raised instead. 4xx client
+    errors and 5xx server faults — ``ValueError``/engine faults
+    in-process — are never retried (retrying a malformed request or a
+    broken engine is not backoff's job). Pass ``retries=0`` for the
+    fail-fast behavior; :class:`PolicyServer`'s internal client does
+    (the HTTP frontend IS the admission layer — retrying server-side
+    would double-count sheds and hide backpressure from remote
+    clients).
     """
 
     def __init__(
@@ -120,8 +131,8 @@ class PolicyClient:
             return self._act_http(
                 obs, deterministic, slot, timeout, request_id
             )
-        return self.batcher.act(
-            obs, deterministic, slot, timeout=timeout, request_id=request_id
+        return self._act_inprocess(
+            obs, deterministic, slot, timeout, request_id
         )
 
     def act_async(
@@ -136,6 +147,55 @@ class PolicyClient:
         return self.batcher.submit(
             obs, deterministic, slot, request_id=request_id
         )
+
+    # ----------------------------------------------------- in-process mode
+
+    def _act_inprocess(self, obs, deterministic, slot, timeout, request_id):
+        """In-process ``act`` with the SAME bounded, deadline-aware
+        retry/backoff contract as HTTP mode: a structured rejection
+        (``ShedError`` — queue full, breaker open, draining, expired)
+        is retried up to ``retries`` times with jittered backoff off
+        the shed's own ``retry_after_s`` hint, never past the caller's
+        ``timeout``; the last rejection is re-raised with its taxonomy
+        intact. Engine faults and request-shape errors propagate
+        unretried (the 5xx/4xx analogue)."""
+        deadline = (
+            time.perf_counter() + timeout if timeout is not None else None
+        )
+        attempt = 0
+        while True:
+            remaining = (
+                deadline - time.perf_counter()
+                if deadline is not None else None
+            )
+            if remaining is not None and remaining <= 0:
+                raise ShedError(
+                    "deadline_infeasible",
+                    f"client deadline of {timeout:.3f}s exhausted "
+                    f"before attempt {attempt + 1}",
+                )
+            try:
+                return self.batcher.act(
+                    obs, deterministic, slot,
+                    timeout=remaining, request_id=request_id,
+                )
+            except ShedError as e:
+                if attempt >= self.retries:
+                    raise
+                delay = max(
+                    e.retry_after_s, self.backoff_s * (2 ** attempt)
+                )
+                delay *= 1.0 + 0.25 * self._rng.random()  # jitter
+                if deadline is not None and (
+                    time.perf_counter() + delay >= deadline
+                ):
+                    # Never retry past the caller's deadline: raise
+                    # the rejection we have (taxonomy intact) instead
+                    # of one we'd manufacture by timing out mid-retry.
+                    raise
+                self.retries_total += 1
+                attempt += 1
+                self._sleep(delay)
 
     # ---------------------------------------------------------- HTTP mode
 
@@ -180,9 +240,11 @@ class PolicyClient:
                     req, timeout=remaining if remaining is not None else 30.0
                 ) as resp:
                     out = json.loads(resp.read())
+                epoch = out.get("epoch")
                 return ActResult(
                     np.asarray(out["action"], dtype=np.float32),
                     int(out.get("generation", 0)),
+                    int(epoch) if epoch is not None else None,
                 )
             except urlerr.HTTPError as e:
                 try:
@@ -322,7 +384,10 @@ class PolicyServer:
                 metrics=self.metrics, seed=seed, capacity=capacity,
                 span_log=span_log, mode=mode,
             )
-        self.client = PolicyClient(registry, self.batcher)
+        # retries=0: the frontend must surface sheds to remote clients
+        # immediately (THEY own retry policy); a retrying internal
+        # client would double-count sheds and sit on handler threads.
+        self.client = PolicyClient(registry, self.batcher, retries=0)
         # Graceful-drain state (docs/SERVING.md "Overload &
         # degradation"): once draining, /healthz answers 503 so load
         # balancers stop routing here, new /act requests are shed with
@@ -521,6 +586,7 @@ class PolicyServer:
                 self._send(200, {
                     "action": np.asarray(res.action).tolist(),
                     "generation": res.generation,
+                    "epoch": res.epoch,
                     "model": slot,
                 }, headers=rid_hdr)
 

@@ -282,7 +282,25 @@ def main(argv=None):
     # lossless resume; a second signal saves at the next update-window
     # boundary instead.
     guard = PreemptionGuard().install() if args.preemption_guard else None
-    trainer = Trainer(
+    # Decoupled actor/learner split (--decoupled true, ROADMAP item 5):
+    # same hardened loop, acting through the serving plane with staged
+    # transitions and per-epoch publishes (docs/RESILIENCE.md
+    # "Decoupled-plane failure modes"). Resume picks the class from the
+    # run's stored config, so `--run <id>` restarts land on the right
+    # plane automatically.
+    if config.decoupled:
+        from torch_actor_critic_tpu.decoupled import DecoupledTrainer
+
+        trainer_cls: type = DecoupledTrainer
+        logger.info(
+            "decoupled actor/learner: serving=%s, max_actor_lag=%d, "
+            "staging=%d (%s)",
+            config.serve_url or "in-process", config.max_actor_lag,
+            config.resolved_staging_capacity, config.staging_policy,
+        )
+    else:
+        trainer_cls = Trainer
+    trainer = trainer_cls(
         env_name,
         config,
         mesh=mesh,

@@ -206,6 +206,40 @@ class SACConfig:
     # sees different env realizations than the run it resumes.
     epoch_reseed: bool = True
 
+    # --- decoupled actor/learner (decoupled/, docs/RESILIENCE.md
+    # "Decoupled-plane failure modes", docs/SERVING.md "Training feeds
+    # serving") ---
+    # Sebulba/TorchBeast-style split: actors fetch actions through the
+    # serving plane (in-process registry+batcher by default, or the
+    # HTTP worker/router at serve_url), stream tagged transitions into
+    # a bounded staging buffer, and the learner publishes each epoch
+    # to the registry via the validated hot-reload. Incompatible with
+    # on_device (acting is fused into the device program there) and
+    # population > 1.
+    decoupled: bool = False
+    # "" = build an in-process serving plane; otherwise the HTTP base
+    # URL of a serve.py worker or fleet router whose slot this run's
+    # checkpoints feed (the worker hot-reload-polls the run's ckpt dir).
+    serve_url: str = ""
+    # Bounded-staleness admission gate: staged transitions published
+    # more than this many epochs before the learner's current epoch
+    # are dropped (counted dropped_stale_total) at drain time. With
+    # one publish per epoch this is exactly the registry-generation
+    # lag. NOTE: in serve_url mode publishes happen on checkpoint
+    # saves, so choose max_actor_lag > save_every there.
+    max_actor_lag: int = 4
+    # Staging queue bound; 0 = auto (4 x update_every, which keeps the
+    # inline actor from ever blocking on its own learner).
+    staging_capacity: int = 0
+    # Backpressure when staging is full: "block" (bounded wait, then
+    # shed), "drop_oldest" (freshest-data-wins), "shed" (refuse new).
+    # All three are counted (decoupled/staging.py).
+    staging_policy: str = "block"
+    # Per-acting-call serving budget: the PolicyClient retries within
+    # it (jittered backoff, deadline-aware) and past it the actor
+    # degrades to its local param snapshot instead of stalling envs.
+    actor_timeout_s: float = 5.0
+
     # --- observability (telemetry/, docs/OBSERVABILITY.md) ---
     # Per-step phase spans (act/env_step/stage/place_chunk/
     # burst_dispatch/drain/sentinel/checkpoint), per-epoch device HBM
@@ -318,6 +352,44 @@ class SACConfig:
             raise ValueError(
                 f"max_rollbacks must be >= 0, got {self.max_rollbacks}"
             )
+        if self.staging_policy not in ("block", "drop_oldest", "shed"):
+            raise ValueError(
+                "staging_policy must be 'block', 'drop_oldest' or "
+                f"'shed', got {self.staging_policy!r}"
+            )
+        if self.max_actor_lag < 0:
+            raise ValueError(
+                f"max_actor_lag must be >= 0, got {self.max_actor_lag}"
+            )
+        if self.staging_capacity < 0:
+            raise ValueError(
+                f"staging_capacity must be >= 0 (0 = auto), got "
+                f"{self.staging_capacity}"
+            )
+        if self.actor_timeout_s <= 0:
+            raise ValueError(
+                f"actor_timeout_s must be > 0, got {self.actor_timeout_s}"
+            )
+        if self.decoupled:
+            if self.on_device:
+                raise ValueError(
+                    "decoupled is the host-loop actor/learner split; "
+                    "on_device fuses acting into the device program — "
+                    "the two cannot compose. Pick one."
+                )
+            if self.population > 1:
+                raise ValueError(
+                    "decoupled does not compose with population > 1 "
+                    "yet (per-member serving slots are not wired); run "
+                    "members as separate decoupled processes instead"
+                )
+            if self.resolved_staging_capacity < self.update_every:
+                raise ValueError(
+                    f"staging_capacity={self.staging_capacity} is "
+                    f"smaller than one update window "
+                    f"(update_every={self.update_every}); the learner "
+                    "could never drain a fixed-size window"
+                )
         if self.actor_param_lag and not self.host_actor:
             raise ValueError(
                 "actor_param_lag requires host_actor=True — the "
@@ -331,6 +403,14 @@ class SACConfig:
         ``round(update_every * utd)``. At the default ``utd=1`` this is
         exactly the reference's one-update-per-env-step cadence."""
         return max(int(round(self.update_every * self.utd)), 1)
+
+    @property
+    def resolved_staging_capacity(self) -> int:
+        """``staging_capacity`` with 0 resolved to ``4 x update_every``
+        — enough headroom that the inline (same-thread) actor can
+        always stage a full window past any gate-dropped leftovers
+        without hitting its own backpressure policy."""
+        return self.staging_capacity or 4 * self.update_every
 
     @property
     def resolved_burst_unroll(self) -> int:
