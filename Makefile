@@ -130,8 +130,12 @@ dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		python __graft_entry__.py 8
 
+# tac-lint: the codebase-native static pass (docs/ANALYSIS.md) —
+# jit-hygiene, recompile-risk, lock-discipline, convention lints.
+# Nonzero exit on any finding; also wired into tier-1 via
+# tests/test_analysis.py's whole-package clean-run test.
 lint:
-	python -m flake8 torch_actor_critic_tpu tests || true
+	python -m torch_actor_critic_tpu.analysis torch_actor_critic_tpu scripts
 
 native:
 	$(MAKE) -C torch_actor_critic_tpu/native

@@ -107,8 +107,10 @@ def smoke_burst():
         next_states=jax.random.normal(ks[3], (500, 17)),
         done=jnp.zeros((500,)),
     )
-    buf = jax.jit(push, donate_argnums=(0,))(buf, chunk)
-    state, buf, m = jax.jit(sac.update_burst, static_argnums=(3,))(
+    push_j = jax.jit(push, donate_argnums=(0,))
+    burst_j = jax.jit(sac.update_burst, static_argnums=(3,))
+    buf = push_j(buf, chunk)
+    state, buf, m = burst_j(
         state, buf, chunk, 50
     )
     assert bool(jnp.isfinite(m["loss_q"])), m
@@ -142,8 +144,10 @@ def smoke_sequence_burst():
         next_states=jax.random.normal(ks[3], (200, horizon, obs_dim)),
         done=jnp.zeros((200,)),
     )
-    buf = jax.jit(push, donate_argnums=(0,))(buf, chunk)
-    state, buf, m = jax.jit(sac.update_burst, static_argnums=(3,))(
+    push_j = jax.jit(push, donate_argnums=(0,))
+    burst_j = jax.jit(sac.update_burst, static_argnums=(3,))
+    buf = push_j(buf, chunk)
+    state, buf, m = burst_j(
         state, buf, chunk, 10
     )
     assert bool(jnp.isfinite(m["loss_q"])), m
@@ -190,8 +194,10 @@ def smoke_visual_burst():
         next_states=obs(ks[4], ks[5]),
         done=jnp.zeros((n,)),
     )
-    buf = jax.jit(push, donate_argnums=(0,))(buf, chunk)
-    state, buf, m = jax.jit(sac.update_burst, static_argnums=(3,))(
+    push_j = jax.jit(push, donate_argnums=(0,))
+    burst_j = jax.jit(sac.update_burst, static_argnums=(3,))
+    buf = push_j(buf, chunk)
+    state, buf, m = burst_j(
         state, buf, chunk, 10
     )
     assert bool(jnp.isfinite(m["loss_q"])), m

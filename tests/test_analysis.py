@@ -1,0 +1,475 @@
+"""tac-lint (torch_actor_critic_tpu/analysis): per-rule fixtures —
+positive and negative per family — plus the whole-package clean-run
+pin that wires the pass into tier-1, and the suppression policy
+(every suppression must name a known rule).
+
+Fixtures go through ``lint_sources`` (in-memory), the same engine
+``python -m torch_actor_critic_tpu.analysis`` / ``make lint`` runs
+over files.
+"""
+
+import pathlib
+import textwrap
+
+import torch_actor_critic_tpu
+from torch_actor_critic_tpu.analysis import (
+    ALL_RULES,
+    RULE_FAMILIES,
+    lint_paths,
+    lint_sources,
+)
+
+REPO = pathlib.Path(torch_actor_critic_tpu.__file__).parent.parent
+PKG = REPO / "torch_actor_critic_tpu"
+SCRIPTS = REPO / "scripts"
+
+
+def lint_one(src: str, path: str = "fixture.py", rules=None):
+    return lint_sources({path: textwrap.dedent(src)}, rules=rules)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------- jit-hygiene
+
+
+def test_host_sync_in_jit_item():
+    findings = lint_one("""
+        import jax
+
+        def fwd(x):
+            return x.item()
+
+        fwd_j = jax.jit(fwd)
+    """)
+    assert rules_of(findings) == ["host-sync-in-jit"]
+    assert findings[0].line == 5
+
+
+def test_host_sync_negative_outside_trace():
+    # .item() in plain host code is fine — only traced code is held to
+    # jit hygiene.
+    findings = lint_one("""
+        def report(x):
+            return x.item()
+    """)
+    assert findings == []
+
+
+def test_host_cast_on_traced_value_flagged_static_shape_not():
+    findings = lint_one("""
+        import jax
+        import numpy as np
+
+        def fwd(x):
+            n = int(np.prod(x.shape))   # static under trace: fine
+            return float(x)             # traced value: host sync
+
+        fwd_j = jax.jit(fwd)
+    """)
+    assert rules_of(findings) == ["host-sync-in-jit"]
+    assert len(findings) == 1
+    assert findings[0].line == 7
+
+
+def test_wallclock_in_jit():
+    findings = lint_one("""
+        import jax
+        import time
+
+        def step(x):
+            return x * time.time()
+
+        step_j = jax.jit(step)
+    """)
+    assert rules_of(findings) == ["wallclock-in-jit"]
+
+
+def test_host_random_in_jit_jax_random_ok():
+    findings = lint_one("""
+        import jax
+        import random
+
+        def step(key, x):
+            a, b = jax.random.split(key)   # traced-safe: never flagged
+            return x + random.random()
+
+        step_j = jax.jit(step)
+    """)
+    assert rules_of(findings) == ["host-random-in-jit"]
+
+
+def test_reachability_through_scan_and_helpers():
+    # The violation sits two hops from the jit boundary: jit -> scan
+    # body -> helper. The reachability walk must still find it.
+    findings = lint_one("""
+        import jax
+        import time
+
+        def helper(c):
+            return c * time.perf_counter()
+
+        def body(c, x):
+            return helper(c), x
+
+        def epoch(c, xs):
+            return jax.lax.scan(body, c, xs)
+
+        epoch_j = jax.jit(epoch)
+    """)
+    assert rules_of(findings) == ["wallclock-in-jit"]
+
+
+def test_stale_entry_point_reported_on_package_runs():
+    # A "package" (root __init__ present) whose seed table files are
+    # gone must fail loudly instead of the walk silently going blind.
+    findings = lint_sources({
+        "torch_actor_critic_tpu/__init__.py": "",
+    })
+    assert "stale-entry-point" in rules_of(findings)
+
+
+# -------------------------------------------------------- recompile-risk
+
+
+def test_jit_cache_discard():
+    findings = lint_one("""
+        import jax
+
+        def fwd(x):
+            return x + 1
+
+        def run(x):
+            return jax.jit(fwd)(x)
+    """)
+    assert rules_of(findings) == ["jit-cache-discard"]
+
+
+def test_jit_bound_then_called_is_clean():
+    findings = lint_one("""
+        import jax
+
+        def fwd(x):
+            return x + 1
+
+        fwd_j = jax.jit(fwd)
+
+        def run(x):
+            return fwd_j(x)
+    """)
+    assert findings == []
+
+
+def test_jit_in_loop():
+    findings = lint_one("""
+        import jax
+
+        def fwd(x):
+            return x + 1
+
+        def run(xs):
+            out = []
+            for x in xs:
+                f = jax.jit(fwd)
+                out.append(f(x))
+            return out
+    """)
+    assert "jit-in-loop" in rules_of(findings)
+
+
+def test_varying_shape_arg():
+    findings = lint_one("""
+        import jax
+
+        def fwd(x):
+            return x.sum()
+
+        fwd_j = jax.jit(fwd)
+
+        def run(x, n):
+            return fwd_j(x[:n])
+    """)
+    assert rules_of(findings) == ["varying-shape-arg"]
+
+
+def test_donated_reuse_flagged_rebind_clean():
+    src = """
+        import jax
+
+        def push(buf, chunk):
+            return buf
+
+        push_j = jax.jit(push, donate_argnums=(0,))
+
+        def bad(buf, chunk):
+            out = push_j(buf, chunk)
+            return buf, out           # buf's buffer may be aliased
+
+        def good(buf, chunk):
+            buf = push_j(buf, chunk)  # rebinding is the sound pattern
+            return buf
+    """
+    findings = lint_one(src)
+    assert rules_of(findings) == ["donated-reuse"]
+    assert len(findings) == 1
+
+
+def test_shard_map_hot_path_and_allowlist():
+    bad = lint_one(
+        """
+        from jax.experimental.shard_map import shard_map
+
+        def burst(f, mesh):
+            return shard_map(f, mesh=mesh)
+        """,
+        path="mypkg/train.py",
+    )
+    assert "shard-map-hot-path" in rules_of(bad)
+    # The rule's home files are exempt by definition.
+    home = lint_one(
+        "from jax.experimental.shard_map import shard_map\n",
+        path="parallel/context.py",
+    )
+    assert home == []
+
+
+def test_stale_allowlist_reported():
+    # A file matching an allowlist entry but containing no shard_map
+    # reference any more: the entry is dead and must be flagged.
+    findings = lint_sources({"parallel/dp.py": "x = 1\n"})
+    assert "stale-allowlist" in rules_of(findings)
+
+
+# ------------------------------------------------------- lock-discipline
+
+
+_LOCKED_CLASS = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []  # guarded-by: _lock
+
+        def add(self, x):
+            {add_body}
+
+        def drain(self):
+            with self._lock:
+                out, self._items = self._items, []
+            return out
+"""
+
+
+def test_unlocked_guarded_access():
+    findings = lint_one(
+        _LOCKED_CLASS.format(add_body="self._items.append(x)")
+    )
+    assert rules_of(findings) == ["unlocked-guarded-access"]
+
+
+def test_guarded_access_under_lock_clean():
+    findings = lint_one(_LOCKED_CLASS.format(
+        add_body="with self._lock:\n                self._items.append(x)"
+    ))
+    assert findings == []
+
+
+def test_lock_holding_method_conventions():
+    # _locked suffix and the "Callers hold self.<lock>" docstring both
+    # mark a method as called under the lock.
+    findings = lint_one("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded-by: _lock
+
+            def _bump_locked(self):
+                self._n += 1
+
+            def _peek(self):
+                \"\"\"Callers hold ``self._lock``.\"\"\"
+                return self._n
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+                    return self._peek()
+    """)
+    assert findings == []
+
+
+def test_condition_aliases_its_lock():
+    findings = lint_one("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._nonempty = threading.Condition(self._lock)
+                self._q = []  # guarded-by: _lock
+
+            def put(self, x):
+                with self._nonempty:
+                    self._q.append(x)
+    """)
+    assert findings == []
+
+
+def test_unguarded_shared_attr():
+    findings = lint_one("""
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def on_request(self):
+                self.count += 1
+
+            def reset(self):
+                self.count = 0
+    """)
+    assert rules_of(findings) == ["unguarded-shared-attr"]
+
+
+def test_unknown_guard():
+    findings = lint_one("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0  # guarded-by: _mutex
+
+            def get(self):
+                with self._lock:
+                    return self._x
+    """)
+    assert "unknown-guard" in rules_of(findings)
+
+
+# ---------------------------------------------------------- conventions
+
+
+def test_silent_exception_swallow_outside_shutdown():
+    findings = lint_one("""
+        def handshake():
+            try:
+                risky()
+            except Exception:
+                pass
+    """)
+    assert rules_of(findings) == ["silent-exception-swallow"]
+
+
+def test_swallow_allowed_on_shutdown_paths_and_narrow_types():
+    findings = lint_one("""
+        def close():
+            try:
+                flush()
+            except Exception:
+                pass
+
+        def handshake():
+            try:
+                risky()
+            except OSError:
+                pass
+    """)
+    assert findings == []
+
+
+def test_mutable_default_arg():
+    findings = lint_one("""
+        def f(xs=[]):
+            return xs
+    """)
+    assert rules_of(findings) == ["mutable-default-arg"]
+
+
+def test_suffix_reduction_mismatch():
+    findings = lint_one("""
+        import jax.numpy as jnp
+
+        def metrics(x):
+            return {
+                "loss_max": jnp.min(x),   # contradicts the suffix
+                "loss_min": jnp.min(x),   # coherent
+                "steps_sum": jnp.sum(x),  # coherent
+            }
+    """)
+    assert rules_of(findings) == ["suffix-reduction-mismatch"]
+    assert len(findings) == 1
+
+
+# ----------------------------------------------------------- suppression
+
+
+def test_suppression_must_name_a_rule():
+    findings = lint_one("""
+        def f(xs=[]):  # tac-lint: disable
+            return xs
+    """)
+    # The blanket suppression suppresses nothing AND is itself a
+    # finding; the mutable default still reports.
+    assert rules_of(findings) == ["bare-suppression", "mutable-default-arg"]
+
+
+def test_suppression_naming_unknown_rule_is_a_finding():
+    findings = lint_one("""
+        def f(xs=[]):  # tac-lint: disable=definitely-not-a-rule
+            return xs
+    """)
+    assert rules_of(findings) == ["bare-suppression", "mutable-default-arg"]
+
+
+def test_named_suppression_suppresses_exactly_that_rule():
+    findings = lint_one("""
+        def f(xs=[]):  # tac-lint: disable=mutable-default-arg
+            return xs
+    """)
+    assert findings == []
+
+
+# --------------------------------------------------------- whole package
+
+
+def test_whole_package_and_scripts_clean():
+    """THE tier-1 wiring: a new violation anywhere in the package or
+    scripts/ fails pytest. Suppression budget (docs/ANALYSIS.md): every
+    remaining suppression names a rule (enforced by bare-suppression)
+    and the total stays small."""
+    findings = lint_paths([str(PKG), str(SCRIPTS)])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_suppression_budget():
+    import re
+
+    n = 0
+    for f in list(PKG.rglob("*.py")) + list(SCRIPTS.rglob("*.py")):
+        if "__pycache__" in f.parts:
+            continue
+        n += len(re.findall(r"tac-lint:\s*disable=", f.read_text()))
+    assert n <= 10, (
+        f"{n} tac-lint suppressions in the package/scripts — the "
+        "budget is 10, each justified in docs/ANALYSIS.md"
+    )
+
+
+def test_rule_catalog_is_consistent():
+    assert ALL_RULES == {
+        r for rules in RULE_FAMILIES.values() for r in rules
+    }
+    # Every family contributes at least one rule and the families the
+    # issue names are all present.
+    for family in (
+        "jit-hygiene", "recompile-risk", "lock-discipline", "conventions",
+    ):
+        assert RULE_FAMILIES[family]

@@ -152,19 +152,27 @@ def test_gspmd_burst_matches_legacy_shard_map_burst():
 
 
 def test_dp_burst_no_shard_map_on_hot_path():
-    """The acceptance pin: the compiled hot path must not route through
-    any shard_map shim — ``parallel.dp`` must not import
-    ``parallel.compat`` (which survives only as a deprecation stub for
-    the parity test above), and the burst must build and run on a jax
-    WITHOUT ``jax.shard_map`` (the installed 0.4.x has none)."""
-    import torch_actor_critic_tpu.parallel.dp as dp_mod
+    """The acceptance pin, promoted from a source-regex check to the
+    tac-lint ``shard-map-hot-path`` rule (docs/ANALYSIS.md): any
+    ``shard_map`` reference outside ``parallel/context.py`` +
+    ``parallel/compat.py`` must sit in the rule's checked allowlist
+    (the ``parallel/__init__`` re-export and the manual-by-nature sp
+    ring burst), and every allowlist entry must still match real code
+    (``stale-allowlist``). Zero findings over the whole package means
+    the allowlist is the single source of truth for where manual
+    mapping is allowed to live."""
+    import pathlib
 
-    src = open(dp_mod.__file__).read()
-    assert "compat" not in src, "parallel/dp.py re-grew a compat import"
-    # The non-sp burst builder must never call a shard_map; only the
-    # ring (sp) branch may, via context.manual_shard_map.
-    hot = src.split("def _build_burst")[1].split("def _build_ring_burst")[0]
-    assert "shard_map" not in hot
+    from torch_actor_critic_tpu.analysis import lint_paths
+
+    pkg = pathlib.Path(
+        __import__("torch_actor_critic_tpu").__file__
+    ).parent
+    findings = [
+        f for f in lint_paths([str(pkg)])
+        if f.rule in ("shard-map-hot-path", "stale-allowlist")
+    ]
+    assert findings == [], "\n".join(f.format() for f in findings)
 
 
 # ----------------------------------------------------- hybrid, no gate

@@ -1,0 +1,110 @@
+"""tac-lint: the codebase-native static-analysis pass.
+
+``python -m torch_actor_critic_tpu.analysis`` (or ``make lint``) runs
+four rule families over the package — jit-hygiene (host syncs and
+host state inside traced code, seeded from the CostRegistry/watchdog
+source names), recompile-risk (jit cache discards, donated-buffer
+reuse, the shard_map hot-path invariant), lock-discipline (the
+``# guarded-by:`` annotation convention on the threaded serving/
+decoupled classes), and convention lints (telemetry suffix-key
+schema, silent exception swallows, mutable defaults). Rule catalog,
+annotation convention and suppression policy: docs/ANALYSIS.md.
+
+The tier-1 wiring is tests/test_analysis.py's whole-package clean-run
+test: a new violation anywhere in the package or scripts/ fails
+``pytest tests/``.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import typing as t
+
+from torch_actor_critic_tpu.analysis import (
+    conventions,
+    jit_hygiene,
+    locks,
+    recompile,
+)
+from torch_actor_critic_tpu.analysis.reachability import (
+    ENTRY_POINTS,
+    Project,
+)
+from torch_actor_critic_tpu.analysis.walker import (
+    ALL_RULES,
+    RULE_FAMILIES,
+    FileContext,
+    Finding,
+    family_of,
+)
+
+__all__ = [
+    "ALL_RULES",
+    "ENTRY_POINTS",
+    "Finding",
+    "RULE_FAMILIES",
+    "family_of",
+    "lint_paths",
+    "lint_sources",
+]
+
+_FAMILY_CHECKS = (
+    jit_hygiene.check,
+    recompile.check,
+    locks.check,
+    conventions.check,
+)
+
+
+def _collect_files(paths: t.Sequence[str]) -> t.List[pathlib.Path]:
+    out: t.List[pathlib.Path] = []
+    for raw in paths:
+        p = pathlib.Path(raw)
+        if p.is_dir():
+            out.extend(sorted(
+                f for f in p.rglob("*.py") if "__pycache__" not in f.parts
+            ))
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def lint_sources(
+    sources: t.Mapping[str, str],
+    rules: t.Collection[str] | None = None,
+) -> t.List[Finding]:
+    """Lint in-memory sources (``{display_path: source}``). The unit
+    the fixture tests drive; :func:`lint_paths` is a thin file-reading
+    wrapper around it."""
+    enabled = set(ALL_RULES if rules is None else rules)
+    contexts = [
+        FileContext(path, src) for path, src in sorted(sources.items())
+    ]
+    project = Project(contexts)
+    findings: t.List[Finding] = []
+    for check in _FAMILY_CHECKS:
+        findings.extend(check(project))
+    by_path = {c.path: c for c in contexts}
+    kept = [
+        f for f in findings
+        if f.rule in enabled
+        and (f.path not in by_path or not by_path[f.path].is_suppressed(f))
+    ]
+    # Malformed suppressions can never suppress themselves.
+    if "bare-suppression" in enabled:
+        for ctx in contexts:
+            kept.extend(ctx.meta_findings)
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return kept
+
+
+def lint_paths(
+    paths: t.Sequence[str],
+    rules: t.Collection[str] | None = None,
+) -> t.List[Finding]:
+    """Lint files/directories on disk; paths in findings are as given
+    (relative stays relative, so ``file:line`` is clickable from the
+    repo root)."""
+    files = _collect_files(paths)
+    sources = {f.as_posix(): f.read_text() for f in files}
+    return lint_sources(sources, rules=rules)

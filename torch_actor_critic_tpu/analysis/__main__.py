@@ -1,0 +1,106 @@
+"""CLI for the tac-lint pass: ``python -m torch_actor_critic_tpu.analysis``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/parse error. ``make lint``
+runs it over the package and ``scripts/``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from torch_actor_critic_tpu.analysis import (
+    ALL_RULES,
+    RULE_FAMILIES,
+    lint_paths,
+)
+
+
+def _default_paths() -> list:
+    pkg = pathlib.Path(__file__).resolve().parent.parent
+    root = pkg.parent
+    out = [pkg]
+    if (root / "scripts").is_dir():
+        out.append(root / "scripts")
+    # Prefer repo-relative display paths when running from the root.
+    cwd = pathlib.Path.cwd()
+    disp = []
+    for p in out:
+        try:
+            disp.append(p.relative_to(cwd).as_posix())
+        except ValueError:
+            disp.append(p.as_posix())
+    return disp
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torch_actor_critic_tpu.analysis",
+        description="tac-lint: jit-hygiene, recompile-risk, "
+        "lock-discipline and convention checks (docs/ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: the package and "
+        "scripts/)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="finding output format",
+    )
+    parser.add_argument(
+        "--select", default=None, metavar="RULES",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--disable", default=None, metavar="RULES",
+        help="comma-separated rule ids to skip",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for family, rules in RULE_FAMILIES.items():
+            print(f"{family}:")
+            for rule in rules:
+                print(f"  {rule}")
+        return 0
+
+    rules = set(ALL_RULES)
+    for raw, keep in ((args.select, True), (args.disable, False)):
+        if raw is None:
+            continue
+        names = {n.strip() for n in raw.split(",") if n.strip()}
+        unknown = names - ALL_RULES
+        if unknown:
+            print(
+                f"unknown rule id(s): {', '.join(sorted(unknown))} "
+                "(see --list-rules)", file=sys.stderr,
+            )
+            return 2
+        rules = (rules & names) if keep else (rules - names)
+
+    paths = args.paths or _default_paths()
+    try:
+        findings = lint_paths(paths, rules=rules)
+    except SyntaxError as e:
+        print(f"parse error: {e}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        n = len(findings)
+        print(f"tac-lint: {n} finding{'s' if n != 1 else ''}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,241 @@
+"""jit-hygiene rules: host-side constructs inside traced code.
+
+Inside a function reachable from a jit/scan entry point
+(:mod:`~torch_actor_critic_tpu.analysis.reachability`), host-device
+sync points and host-state reads are silent performance/correctness
+hazards:
+
+* ``host-sync-in-jit`` — ``.item()`` / ``.tolist()`` /
+  ``.block_until_ready()`` / ``jax.device_get`` anywhere in traced
+  code, and ``float()``/``int()``/``bool()`` casts or ``np.*`` calls
+  applied to *traced values* (approximated as values derived from the
+  traced function's parameters — closure variables are typically
+  trace-time constants and stay exempt). Each of these either forces a
+  device->host transfer per step or raises a ``TracerArrayConversion``
+  at trace time; on the fused Podracer-style loops one stray sync is
+  the difference between 0.70 and 0.02 MFU (PAPERS.md, BENCH_r03-r05).
+* ``wallclock-in-jit`` — ``time.*`` / ``datetime.now`` in traced code
+  reads the clock ONCE at trace time and bakes the value into the
+  compiled program: the metric it feeds goes silently constant.
+* ``host-random-in-jit`` — stdlib ``random.*`` / ``np.random.*`` in
+  traced code is the same bug for randomness (``jax.random`` with
+  explicit keys is the traced-safe spelling and is never flagged).
+"""
+
+from __future__ import annotations
+
+import ast
+import typing as t
+
+from torch_actor_critic_tpu.analysis.reachability import (
+    CALLBACK_WRAPPERS,
+    Project,
+    _is_wrapper,
+)
+from torch_actor_critic_tpu.analysis.walker import (
+    Finding,
+    FunctionInfo,
+    dotted_name,
+)
+
+__all__ = ["check"]
+
+FAMILY = "jit-hygiene"
+
+# Attribute-call syncs flagged on ANY receiver inside traced code.
+_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+_SYNC_CALLS = frozenset({"jax.device_get", "device_get"})
+_CAST_BUILTINS = frozenset({"float", "int", "bool", "complex"})
+_WALLCLOCK = frozenset({
+    "time.time", "time.perf_counter", "time.monotonic", "time.sleep",
+    "time.process_time", "time.time_ns", "time.perf_counter_ns",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+})
+_NP_ALIASES = ("np", "numpy")
+
+
+def _is_host_random(name: str) -> bool:
+    parts = name.split(".")
+    if parts[0] == "random" and len(parts) > 1:
+        return True
+    return len(parts) >= 3 and parts[-3] in _NP_ALIASES and parts[-2] == "random"
+
+
+def _param_names(node: ast.AST) -> t.Set[str]:
+    if isinstance(node, ast.Lambda):
+        args = node.args
+    elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        args = node.args
+    else:  # pragma: no cover - defensive
+        return set()
+    names = {
+        a.arg
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        )
+    }
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+def _tainted_names(fn_node: ast.AST) -> t.Set[str]:
+    """Parameters plus names assigned from param-derived expressions
+    (two fixed-point passes — enough for the straight-line bodies jit
+    functions have)."""
+    tainted = _param_names(fn_node)
+    body = getattr(fn_node, "body", None)
+    if body is None or isinstance(body, ast.AST):  # Lambda
+        return tainted
+    for _ in range(2):
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Assign):
+                continue
+            if _derives_from(node.value, tainted):
+                for target in node.targets:
+                    for n in ast.walk(target):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+    return tainted
+
+
+# Attribute reads that are static under trace: a tracer's .shape /
+# .dtype / .ndim are Python values at trace time, so host math over
+# them is fine (and idiomatic — bucket ladders, fsdp spec planning).
+_STATIC_ATTRS = frozenset({
+    "shape", "dtype", "ndim", "size", "nbytes", "itemsize", "sharding",
+})
+
+
+def _derives_from(node: ast.AST, tainted: t.Set[str]) -> bool:
+    """Does the expression read a tainted name through a non-static
+    path? ``x`` and ``x[0]`` taint; ``x.shape`` / ``np.prod(x.shape)``
+    do not."""
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in tainted and isinstance(node.ctx, ast.Load)
+    return any(
+        _derives_from(child, tainted)
+        for child in ast.iter_child_nodes(node)
+    )
+
+
+def _callback_subtrees(fn_node: ast.AST) -> t.Set[ast.AST]:
+    """Function/lambda nodes inside ``fn_node`` that are host-callback
+    bodies (their code runs on the host; hygiene rules skip them)."""
+    out: t.Set[ast.AST] = set()
+    local_defs = {
+        n.name: n for n in ast.walk(fn_node)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _is_wrapper(dotted_name(node.func), CALLBACK_WRAPPERS):
+            continue
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                out.add(arg)
+            name = dotted_name(arg)
+            if name in local_defs:
+                out.add(local_defs[name])
+    return out
+
+
+def _walk_skipping(root: ast.AST, skip: t.Set[ast.AST]) -> t.Iterator[ast.AST]:
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if node in skip:
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check(project: Project) -> t.List[Finding]:
+    findings: t.List[Finding] = []
+    seen: t.Set[t.Tuple[str, int, int, str]] = set()
+
+    def emit(rule, path, node, message, hint):
+        key = (path, node.lineno, node.col_offset, rule)
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(
+            Finding(rule, path, node.lineno, node.col_offset, message, hint)
+        )
+
+    findings.extend(project.entry_point_findings())
+
+    for (path, _), fn in sorted(
+        project.traced().items(), key=lambda kv: (kv[0][0], kv[0][1])
+    ):
+        fn_node: ast.AST = fn.node
+        tainted = _tainted_names(fn_node)
+        skip = _callback_subtrees(fn_node)
+        where = f"traced function {fn.qualname!r}"
+        for node in _walk_skipping(fn_node, skip):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in _SYNC_METHODS and not node.args:
+                    emit(
+                        "host-sync-in-jit", path, node,
+                        f".{node.func.attr}() inside {where} forces a "
+                        "device->host sync every trace execution",
+                        "keep the value on device (jnp reductions) or move "
+                        "the read outside the jit boundary",
+                    )
+                    continue
+            if name is None:
+                continue
+            if name in _SYNC_CALLS:
+                emit(
+                    "host-sync-in-jit", path, node,
+                    f"{name}() inside {where} is a host transfer",
+                    "return the array and read it outside the trace",
+                )
+            elif name in _CAST_BUILTINS and len(node.args) == 1 and (
+                _derives_from(node.args[0], tainted)
+            ):
+                emit(
+                    "host-sync-in-jit", path, node,
+                    f"{name}() on a traced value inside {where} "
+                    "(concretization error or silent host sync)",
+                    "use jnp casts (.astype) on device, or mark the "
+                    "argument static at the jit boundary",
+                )
+            elif name.split(".")[0] in _NP_ALIASES and (
+                not _is_host_random(name)
+                and len(node.args) >= 1
+                and _derives_from(node.args[0], tainted)
+            ):
+                emit(
+                    "host-sync-in-jit", path, node,
+                    f"{name}() on a traced value inside {where} "
+                    "materializes on host",
+                    "use the jnp equivalent so the op stays in the trace",
+                )
+            if name in _WALLCLOCK:
+                emit(
+                    "wallclock-in-jit", path, node,
+                    f"{name}() inside {where} is evaluated ONCE at trace "
+                    "time; the compiled program sees a constant",
+                    "take timings on the host around the jit call "
+                    "(telemetry phase spans), not inside it",
+                )
+            elif _is_host_random(name):
+                emit(
+                    "host-random-in-jit", path, node,
+                    f"{name}() inside {where} draws host randomness at "
+                    "trace time (constant in the compiled program)",
+                    "thread a jax.random key through the trace instead",
+                )
+    return findings

@@ -104,17 +104,21 @@ class StagingBuffer:
         self.policy = policy
         self.max_lag = max_lag
         self.block_timeout_s = float(block_timeout_s)
-        self._q: collections.deque[StagedTransition] = collections.deque()
+        self._q: collections.deque[StagedTransition] = (  # guarded-by: _cond
+            collections.deque()
+        )
         self._cond = threading.Condition()
-        self._closed = False
+        self._closed = False  # guarded-by: _cond
         # Counted outcomes (the conservation invariant; module docstring).
-        self.staged_total = 0
-        self.drained_total = 0
-        self.dropped_stale_total = 0
-        self.dropped_backpressure_total = 0
-        self.shed_total = 0
-        self.blocked_total = 0
-        self.lag_hist = FixedBucketHistogram(**_LAG_HIST_SPEC)
+        self.staged_total = 0  # guarded-by: _cond
+        self.drained_total = 0  # guarded-by: _cond
+        self.dropped_stale_total = 0  # guarded-by: _cond
+        self.dropped_backpressure_total = 0  # guarded-by: _cond
+        self.shed_total = 0  # guarded-by: _cond
+        self.blocked_total = 0  # guarded-by: _cond
+        self.lag_hist = FixedBucketHistogram(  # guarded-by: _cond
+            **_LAG_HIST_SPEC
+        )
 
     # ------------------------------------------------------------ actors
 
@@ -238,7 +242,8 @@ class StagingBuffer:
 
     @property
     def paused(self) -> bool:
-        return self._closed
+        with self._cond:
+            return self._closed
 
     # ----------------------------------------------------- introspection
 

@@ -91,19 +91,19 @@ class RecompilationWatchdog:
     def __init__(self):
         self._lock = threading.Lock()
         self._tls = threading.local()
-        self.installed = False
-        self.compiles_total = 0
-        self.by_source: t.Dict[str, int] = {}
-        self.compile_time_s = 0.0
-        self.post_steady_total = 0
-        self.anomalies: t.List[dict] = []
-        self._steady_prefixes: t.Set[str] = set()
+        self.installed = False  # guarded-by: _lock
+        self.compiles_total = 0  # guarded-by: _lock
+        self.by_source: t.Dict[str, int] = {}  # guarded-by: _lock
+        self.compile_time_s = 0.0  # guarded-by: _lock
+        self.post_steady_total = 0  # guarded-by: _lock
+        self.anomalies: t.List[dict] = []  # guarded-by: _lock
+        self._steady_prefixes: t.Set[str] = set()  # guarded-by: _lock
         # Bounded per-compile record ring (source, end wall time,
         # duration): the cross-plane trace export draws compile spans
         # from here (telemetry/traceview.py). Newest-wins, so a long
         # run keeps the recent window a trace would cover anyway.
-        self._compile_log: collections.deque = collections.deque(
-            maxlen=_MAX_COMPILE_LOG
+        self._compile_log: collections.deque = (  # guarded-by: _lock
+            collections.deque(maxlen=_MAX_COMPILE_LOG)
         )
 
     # ------------------------------------------------------------ install

@@ -43,6 +43,7 @@ import atexit
 import logging
 import multiprocessing as mp
 import os
+import sys
 import typing as t
 from multiprocessing import shared_memory
 
@@ -285,8 +286,17 @@ def _worker_main(
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    except Exception:  # pragma: no cover - jax config is best-effort here
-        pass
+    except (ImportError, AttributeError, ValueError) as e:
+        # pragma: no cover — config shims vary by jax version. The env
+        # var above is the load-bearing guard; a failed in-process
+        # override is survivable but must leave evidence: a worker that
+        # DID grab the parent's accelerator deadlocks the handshake,
+        # and this line is the only clue pointing at which one.
+        print(
+            f"[vec_env worker {idx}] jax cpu-config override failed "
+            f"({e!r}); relying on JAX_PLATFORMS=cpu alone",
+            file=sys.stderr,
+        )
     from torch_actor_critic_tpu.native import load_runtime
 
     shm = None
@@ -405,11 +415,18 @@ class ParallelEnvPool:
                 self._recv(i, "ready")
         except Exception:
             # A failed handshake must not strand parked workers (close()
-            # is not reachable yet): tear everything down, then re-raise.
+            # is not reachable yet): tear everything down, then re-raise
+            # — with each worker's exitcode on record, because the
+            # original error ("spec" never arrived / pipe EOF) rarely
+            # says WHICH worker died or how.
             for p in self._procs:
                 if p.is_alive():
                     p.terminate()
                 p.join(timeout=2)
+            logger.warning(
+                "vec_env handshake failed; worker exitcodes: %s",
+                [p.exitcode for p in self._procs],
+            )
             for conn in self._conns:
                 conn.close()
             if hasattr(self, "_shm"):

@@ -505,9 +505,8 @@ class PopulationOnDeviceLoop:
             return ts, buf, es, k_act
 
         member_keys = jax.random.split(key, self.n_members)
-        state, buffer, env_states, act_keys = jax.jit(
-            jax.vmap(member_init)
-        )(member_keys)
+        init_members = jax.jit(jax.vmap(member_init))
+        state, buffer, env_states, act_keys = init_members(member_keys)
         if self.pbt:
             state = state.replace(
                 hyperparams=self._init_hyperparams(

@@ -176,21 +176,23 @@ class MicroBatcher:
         # `is not None` check when detached — the serving twin of the
         # trainer's telemetry=None contract.
         self.span_log = span_log
-        self._key = jax.random.key(seed)
-        self._queue: collections.deque[_Request] = collections.deque()
+        self._key = jax.random.key(seed)  # guarded-by: _lock
+        self._queue: collections.deque[_Request] = (  # guarded-by: _lock
+            collections.deque()
+        )
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         # Measured service rate (EMA of seconds per dispatched row),
         # written by the dispatcher after each group, read under the
         # lock by submit-time deadline-feasibility checks.
-        self._ema_row_s: float | None = None
-        self._ema_samples = 0
+        self._ema_row_s: float | None = None  # guarded-by: _lock
+        self._ema_samples = 0  # guarded-by: _lock
         # Rows popped off the queue but not yet resolved (the group
         # currently inside the engine). The fleet's least-loaded
         # dispatcher reads load_rows() = queued + in-flight: a replica
         # mid-forward with an empty queue is NOT idle.
-        self._inflight_rows = 0
-        self._running = True
+        self._inflight_rows = 0  # guarded-by: _lock
+        self._running = True  # guarded-by: _lock
         self._thread = threading.Thread(
             target=self._dispatch_loop, name="micro-batcher", daemon=True
         )
@@ -513,8 +515,13 @@ class MicroBatcher:
         return group
 
     def _next_key(self):
-        self._key, sub = jax.random.split(self._key)
-        return sub
+        # Under the lock: the dispatcher splitting here races
+        # import_key() restoring a checkpointed key on the learner
+        # thread (decoupled resume) — an unlocked split could clobber
+        # the restored stream. Found by tac-lint (unguarded-shared-attr).
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
 
     def _slot_epoch(self, slot_name: str) -> int | None:
         """The slot's published training epoch, when the registry

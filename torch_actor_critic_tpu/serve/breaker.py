@@ -77,18 +77,23 @@ class CircuitBreaker:
         self._clock = clock
         self.on_event = on_event
         self._lock = threading.Lock()
-        self._state = CLOSED
-        self._consecutive_failures = 0
-        self._opened_at: float | None = None
-        self._probes_inflight = 0
-        self.trips_total = 0
-        self.probes_total = 0
-        self.failures_total = 0
-        self.successes_total = 0
+        self._state = CLOSED  # guarded-by: _lock
+        self._consecutive_failures = 0  # guarded-by: _lock
+        self._opened_at: float | None = None  # guarded-by: _lock
+        self._probes_inflight = 0  # guarded-by: _lock
+        self.trips_total = 0  # guarded-by: _lock
+        self.probes_total = 0  # guarded-by: _lock
+        self.failures_total = 0  # guarded-by: _lock
+        self.successes_total = 0  # guarded-by: _lock
 
     # ------------------------------------------------------------- events
 
     def _emit(self, event: str, **fields):
+        """Build + deliver one transition event. Callers hold
+        ``self._lock`` (every emit sits inside a state transition), so
+        the snapshot fields are consistent; the ``on_event`` sink is
+        therefore invoked under the breaker lock and must not call
+        back into this breaker."""
         payload = dict(
             event=event, breaker=self.name, state=self._state,
             consecutive_failures=self._consecutive_failures,

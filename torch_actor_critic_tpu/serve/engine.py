@@ -131,7 +131,7 @@ class PolicyEngine:
                 fwd_sampled, donate_argnums=(1,) if donate else ()
             ),
         }
-        self._compiled: set = set()  # {(bucket, deterministic)}
+        self._compiled: set = set()  # {(bucket, det)}; guarded-by: _lock
         self._lock = threading.Lock()
         # Precomputed jax.profiler span labels (one per bucket): under
         # an active trace each serving forward shows up as a labeled
@@ -148,10 +148,12 @@ class PolicyEngine:
         # every real backend compile (including re-compiles of
         # already-seen keys) to this engine's `serve/forward[bN]`
         # source labels and flags post-steady ones as anomalies.
-        self._compile_counts: t.Dict[int, t.List[int]] = {}  # b -> [wrm, live]
-        self.compiles_total = 0
-        self._warmup_active = False
-        self._warmed = False
+        self._compile_counts: t.Dict[int, t.List[int]] = (  # guarded-by: _lock
+            {}
+        )  # bucket -> [warmup, live]
+        self.compiles_total = 0  # guarded-by: _lock
+        self._warmup_active = False  # guarded-by: _lock
+        self._warmed = False  # guarded-by: _lock
         self._watchdog = get_watchdog().install()
 
     def replicate(self) -> "PolicyEngine":
@@ -273,7 +275,8 @@ class PolicyEngine:
 
         warmed = []
         key = jax.random.key(0)
-        self._warmup_active = True
+        with self._lock:
+            self._warmup_active = True
         try:
             with self._watchdog.expected():
                 for bucket in (buckets or self.buckets):
@@ -315,6 +318,7 @@ class PolicyEngine:
                         warmed.append((bucket, det))
                     del out
         finally:
-            self._warmup_active = False
-            self._warmed = True
+            with self._lock:
+                self._warmup_active = False
+                self._warmed = True
         return warmed

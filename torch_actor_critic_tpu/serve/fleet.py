@@ -86,9 +86,11 @@ class _ReplicaRegistry:
         self._base = base
         self.device = device
         self.index = index
-        self._engines: t.Dict[str, PolicyEngine] = {}
-        self._params: t.Dict[str, t.Tuple[int, t.Any]] = {}
-        self._breakers: t.Dict[str, CircuitBreaker] = {}
+        self._engines: t.Dict[str, PolicyEngine] = {}  # guarded-by: _lock
+        self._params: t.Dict[str, t.Tuple[int, t.Any]] = (  # guarded-by: _lock
+            {}
+        )
+        self._breakers: t.Dict[str, CircuitBreaker] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def acquire(self, name: str = "default"):
@@ -205,8 +207,11 @@ class EngineFleet:
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.span_log = span_log
         self._lock = threading.Lock()
-        self._rr = 0  # round-robin cursor for idle ties
-        self._running = True
+        self._rr = 0  # round-robin cursor for idle ties; guarded-by: _lock
+        self._running = True  # guarded-by: _lock
+        # _replicas is append-only during __init__ and immutable after
+        # (replica-internal state has its own locks), so reads are safe
+        # anywhere.
         self._replicas = []
         for i, dev in enumerate(devices):
             view = _ReplicaRegistry(registry, dev, i)

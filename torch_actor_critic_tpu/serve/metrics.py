@@ -38,31 +38,33 @@ class ServeMetrics:
     def __init__(self):
         self._lock = threading.Lock()
         self._t_start = time.perf_counter()
-        self._t_snapshot = self._t_start
-        self.requests_total = 0
-        self.responses_total = 0
-        self.errors_total = 0
-        self.batches_total = 0
-        self.rows_total = 0
-        self.padded_rows_total = 0  # sum of bucket sizes dispatched
-        self.queue_depth = 0
+        self._t_snapshot = self._t_start  # guarded-by: _lock
+        self.requests_total = 0  # guarded-by: _lock
+        self.responses_total = 0  # guarded-by: _lock
+        self.errors_total = 0  # guarded-by: _lock
+        self.batches_total = 0  # guarded-by: _lock
+        self.rows_total = 0  # guarded-by: _lock
+        self.padded_rows_total = 0  # bucket sizes sum; guarded-by: _lock
+        self.queue_depth = 0  # guarded-by: _lock
         # Admission-control accounting (docs/SERVING.md "Overload &
         # degradation"): submit-time rejections by reason, plus
         # accepted-then-purged requests whose deadline expired in the
         # queue (the TPU never ran them).
-        self.sheds_total = 0
-        self.shed_by_reason: t.Dict[str, int] = {}
-        self.shed_expired_total = 0
-        self._responses_at_snapshot = 0
-        self._snapshots_taken = 0
-        self._latency = FixedBucketHistogram()
+        self.sheds_total = 0  # guarded-by: _lock
+        self.shed_by_reason: t.Dict[str, int] = {}  # guarded-by: _lock
+        self.shed_expired_total = 0  # guarded-by: _lock
+        self._responses_at_snapshot = 0  # guarded-by: _lock
+        self._snapshots_taken = 0  # guarded-by: _lock
+        self._latency = FixedBucketHistogram()  # guarded-by: _lock
         # Per-bucket forward-time accounting (cost attribution): the
         # dispatcher reports each engine call's measured duration so
         # /metrics can combine it with the bucket program's registered
         # FLOPs/bytes into a live roofline (docs/OBSERVABILITY.md
         # "Cost attribution & roofline").
-        self._bucket_time: t.Dict[int, t.Dict[str, float]] = {}
-        self._peaks = None  # costmodel.Peaks, detected lazily
+        self._bucket_time: t.Dict[int, t.Dict[str, float]] = (
+            {}
+        )  # guarded-by: _lock
+        self._peaks = None  # costmodel.Peaks, lazy; guarded-by: _lock
 
     # ----------------------------------------------------------- recording
 

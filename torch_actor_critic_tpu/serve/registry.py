@@ -78,9 +78,9 @@ class ModelRegistry:
         reload_retry_backoff_s: float = 0.5,
         sleep: t.Callable[[float], None] = time.sleep,
     ):
-        self._slots: t.Dict[str, _Slot] = {}
+        self._slots: t.Dict[str, _Slot] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._poller: threading.Thread | None = None
+        self._poller: threading.Thread | None = None  # guarded-by: _lock
         self._poll_stop = threading.Event()
         # Transient-IO policy for hot-reload (resilience/retry.py):
         # each slot's probe+restore gets `reload_retries` extra
@@ -93,8 +93,8 @@ class ModelRegistry:
         # Bounded breaker-transition log: the telemetry-events view of
         # every slot breaker (each entry is a JSONL-ready dict), capped
         # so a flapping breaker cannot grow host memory.
-        self._breaker_events: collections.deque = collections.deque(
-            maxlen=256
+        self._breaker_events: collections.deque = (  # guarded-by: _lock
+            collections.deque(maxlen=256)
         )
 
     # ------------------------------------------------------- registration
@@ -192,12 +192,18 @@ class ModelRegistry:
     # ------------------------------------------------------------ reading
 
     def _slot(self, name: str) -> _Slot:
-        try:
-            return self._slots[name]
-        except KeyError:
-            raise KeyError(
-                f"unknown model slot {name!r}; have {sorted(self._slots)}"
-            ) from None
+        # Under the registry lock: a lookup racing register(...,
+        # replace=True) must see either the old slot or the new one,
+        # never a half-updated dict view. Callers never hold _lock
+        # here (found by tac-lint, unlocked-guarded-access).
+        with self._lock:
+            try:
+                return self._slots[name]
+            except KeyError:
+                raise KeyError(
+                    f"unknown model slot {name!r}; have "
+                    f"{sorted(self._slots)}"
+                ) from None
 
     def acquire(self, name: str = "default"):
         """``(engine, params, generation)`` — the triple a batch runs
@@ -223,7 +229,8 @@ class ModelRegistry:
     def breaker(self, name: str = "default") -> CircuitBreaker | None:
         """The slot's circuit breaker (None only for foreign slots —
         every registered slot has one)."""
-        slot = self._slots.get(name)
+        with self._lock:
+            slot = self._slots.get(name)
         return slot.breaker if slot is not None else None
 
     def slots(self) -> t.Dict[str, dict]:
@@ -234,6 +241,7 @@ class ModelRegistry:
         for name, slot in items:
             with slot.lock:
                 _, generation, epoch = slot.state
+                rejected = slot.reload_rejected_total
             out[name] = {
                 "generation": generation,
                 "epoch": epoch,
@@ -243,7 +251,7 @@ class ModelRegistry:
                     [list(k) for k in slot.engine.compiled_buckets()]
                 ),
                 "breaker": slot.breaker.state,
-                "reload_rejected_total": slot.reload_rejected_total,
+                "reload_rejected_total": rejected,
             }
         return out
 
@@ -266,7 +274,8 @@ class ModelRegistry:
 
     def _note_breaker_event(self, event: dict):
         event = dict(event, ts=time.time())
-        self._breaker_events.append(event)
+        with self._lock:
+            self._breaker_events.append(event)
         logger.warning("breaker event: %s", event)
 
     def note_breaker_event(self, event: dict):
@@ -280,7 +289,8 @@ class ModelRegistry:
     def breaker_events(self) -> t.List[dict]:
         """The most recent breaker transitions (bounded), each a
         JSONL-ready telemetry event dict."""
-        return list(self._breaker_events)
+        with self._lock:
+            return list(self._breaker_events)
 
     def breaker_stats(self) -> dict:
         """Per-slot breaker state for ``/metrics``: state machine
@@ -288,12 +298,14 @@ class ModelRegistry:
         with self._lock:
             items = list(self._slots.items())
         slots = {name: slot.breaker.snapshot() for name, slot in items}
+        with self._lock:
+            events_total = len(self._breaker_events)
         return {
             "trips_total": sum(s["trips_total"] for s in slots.values()),
             "open_slots": sorted(
                 name for name, s in slots.items() if s["state"] != "closed"
             ),
-            "events_total": len(self._breaker_events),
+            "events_total": events_total,
             "slots": slots,
         }
 
@@ -373,7 +385,8 @@ class ModelRegistry:
             # generation serving and the rejection is reported, not
             # raised mid-serve.
             if not tree_all_finite(params):
-                slot.reload_rejected_total += 1
+                with slot.lock:
+                    slot.reload_rejected_total += 1
                 logger.warning(
                     "slot %r reload REJECTED: epoch %s params are "
                     "non-finite; generation %s (last good) keeps "
@@ -430,10 +443,6 @@ class ModelRegistry:
         poll — reload already isolates per-slot failures, and any
         error that still escapes is logged and the next tick polls
         again."""
-        if self._poller is not None:
-            raise RuntimeError("poller already running")
-        self._poll_stop.clear()
-
         def loop():
             while not self._poll_stop.wait(timeout=interval_s):
                 try:
@@ -443,17 +452,27 @@ class ModelRegistry:
                     # watcher's own last line of defense
                     logger.exception("hot-reload poll failed; will retry")
 
-        self._poller = threading.Thread(
-            target=loop, name="ckpt-poller", daemon=True
-        )
-        self._poller.start()
+        with self._lock:
+            if self._poller is not None:
+                raise RuntimeError("poller already running")
+            self._poll_stop.clear()
+            self._poller = threading.Thread(
+                target=loop, name="ckpt-poller", daemon=True
+            )
+            poller = self._poller
+        poller.start()
 
     def stop_polling(self):
-        if self._poller is None:
+        # Swap the handle out under the lock, join OUTSIDE it: the
+        # poller's reload() briefly takes _lock, so joining while
+        # holding it would stall the stop by up to one full poll.
+        with self._lock:
+            poller = self._poller
+            self._poller = None
+        if poller is None:
             return
         self._poll_stop.set()
-        self._poller.join(timeout=10.0)
-        self._poller = None
+        poller.join(timeout=10.0)
 
     def close(self):
         self.stop_polling()

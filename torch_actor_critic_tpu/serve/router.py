@@ -124,13 +124,13 @@ class FleetRouter:
         self.health_timeout_s = float(health_timeout_s)
         self.span_log = span_log
         self._lock = threading.Lock()
-        self._rr = 0
+        self._rr = 0  # guarded-by: _lock
         self._reload_lock = threading.Lock()
         self._poll_stop = threading.Event()
-        self._poller: threading.Thread | None = None
-        self.routed_total = 0
-        self.failovers_total = 0
-        self.no_worker_total = 0
+        self._poller: threading.Thread | None = None  # guarded-by: _lock
+        self.routed_total = 0  # guarded-by: _lock
+        self.failovers_total = 0  # guarded-by: _lock
+        self.no_worker_total = 0  # guarded-by: _lock
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -181,7 +181,7 @@ class FleetRouter:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
-        self._thread: threading.Thread | None = None
+        self._thread: threading.Thread | None = None  # guarded-by: _lock
 
     # ---------------------------------------------------------- membership
 
@@ -258,13 +258,14 @@ class FleetRouter:
     def membership(self) -> dict:
         with self._lock:
             views = {n: w.view() for n, w in self.workers.items()}
+            routed, failovers = self.routed_total, self.failovers_total
         return {
             "workers": views,
             "admitted_workers": sum(
                 1 for v in views.values() if v["admitted"]
             ),
-            "routed_total": self.routed_total,
-            "failovers_total": self.failovers_total,
+            "routed_total": routed,
+            "failovers_total": failovers,
         }
 
     # ------------------------------------------------------------- routing
@@ -409,9 +410,9 @@ class FleetRouter:
             for w in list(self.workers.values())
         }
         out = aggregate_snapshots(snaps)
-        out["router"] = dict(
-            self.membership(), no_worker_total=self.no_worker_total,
-        )
+        with self._lock:
+            no_worker = self.no_worker_total
+        out["router"] = dict(self.membership(), no_worker_total=no_worker)
         return out
 
     # ------------------------------------------------------ rolling reload
@@ -488,22 +489,25 @@ class FleetRouter:
                     # membership must survive any one bad poll
                     logger.exception("membership poll failed; will retry")
 
-        self._poller = threading.Thread(
-            target=poll_loop, name="fleet-membership", daemon=True
-        )
-        self._poller.start()
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="fleet-router",
-            daemon=True,
-        )
-        self._thread.start()
+        with self._lock:
+            poller = self._poller = threading.Thread(
+                target=poll_loop, name="fleet-membership", daemon=True
+            )
+            http = self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name="fleet-router",
+                daemon=True,
+            )
+        poller.start()
+        http.start()
         return self
 
     def serve_forever(self):
         """Block serving until interrupted (the CLI path)."""
         self.start()
+        with self._lock:
+            http = self._thread
         try:
-            self._thread.join()
+            http.join()
         except KeyboardInterrupt:  # pragma: no cover — operator stop
             pass
         finally:
@@ -511,14 +515,18 @@ class FleetRouter:
 
     def close(self):
         self._poll_stop.set()
-        if self._poller is not None:
-            self._poller.join(timeout=10.0)
-            self._poller = None
+        # Swap the handle out under the lock, join OUTSIDE it: the
+        # poll loop's poll_once() takes _lock per worker.
+        with self._lock:
+            poller, self._poller = self._poller, None
+        if poller is not None:
+            poller.join(timeout=10.0)
         self._httpd.shutdown()
         self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
-            self._thread = None
+        with self._lock:
+            http, self._thread = self._thread, None
+        if http is not None:
+            http.join(timeout=10.0)
 
     def __enter__(self):
         return self

@@ -394,7 +394,7 @@ class PolicyServer:
         # 503 + Retry-After, and the queue flushes through the engine
         # before the process exits — rolling restarts drop zero
         # accepted requests.
-        self._draining = False
+        self._draining = False  # guarded-by: _drain_lock
         self._drain_lock = threading.Lock()
         server = self
 
@@ -428,7 +428,7 @@ class PolicyServer:
 
             def do_GET(self):  # noqa: N802 — stdlib API
                 if self.path == "/healthz":
-                    draining = server._draining
+                    draining = server.draining
                     self._send(
                         503 if draining else 200,
                         {
@@ -453,7 +453,7 @@ class PolicyServer:
                     # Overload containment state: admission bound and
                     # per-slot breaker trips/probes/state.
                     snap["queue_capacity"] = server.batcher.capacity
-                    snap["draining"] = server._draining
+                    snap["draining"] = server.draining
                     snap["breakers"] = server.registry.breaker_stats()
                     # Engine-per-device fleet view (serve/fleet.py):
                     # per-replica load/EMA/dispatch share + per-replica
@@ -502,7 +502,7 @@ class PolicyServer:
                 # be matched to its timeline.
                 rid = self.headers.get("X-Request-Id") or uuid.uuid4().hex[:16]
                 rid_hdr = {"X-Request-Id": rid}
-                if server._draining:
+                if server.draining:
                     logger.warning(
                         "shed request_id=%s reason=draining", rid
                     )
@@ -592,11 +592,11 @@ class PolicyServer:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
-        self._thread: threading.Thread | None = None
+        self._thread: threading.Thread | None = None  # guarded-by: _drain_lock
         # shutdown() on a loop that NEVER ran blocks forever (stdlib
         # waits on the flag only serve_forever sets); close() skips it
         # unless one of the serve entry points actually started.
-        self._loop_started = False
+        self._loop_started = False  # guarded-by: _drain_lock
 
     @property
     def port(self) -> int:
@@ -613,17 +613,20 @@ class PolicyServer:
         # serving-bucket compile is a steady-state anomaly (slots that
         # register later run their warmup as `expected`).
         _watchdog().install().mark_steady("serve/")
-        self._loop_started = True
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="policy-http", daemon=True
-        )
-        self._thread.start()
+        with self._drain_lock:
+            self._loop_started = True
+            thread = self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name="policy-http",
+                daemon=True,
+            )
+        thread.start()
         return self
 
     def serve_forever(self):
         """Block serving until interrupted (the CLI path)."""
         _watchdog().install().mark_steady("serve/")
-        self._loop_started = True
+        with self._drain_lock:
+            self._loop_started = True
         try:
             self._httpd.serve_forever()
         except KeyboardInterrupt:  # pragma: no cover — operator stop
@@ -633,7 +636,8 @@ class PolicyServer:
 
     @property
     def draining(self) -> bool:
-        return self._draining
+        with self._drain_lock:
+            return self._draining
 
     def drain(self, flush_timeout_s: float = 30.0) -> dict:
         """Graceful drain: stop admitting, flush, report.
@@ -677,25 +681,29 @@ class PolicyServer:
         know a non-daemon-joinable thread is still out there."""
         result = {"server_thread_stopped": True}
         _watchdog().clear_steady("serve/")
-        if self._loop_started:
+        # Read/clear the lifecycle handles under the lock; shutdown()
+        # and join() run OUTSIDE it — a wedged handler wanting the
+        # drain lock must never deadlock close().
+        with self._drain_lock:
+            loop_started = self._loop_started
+            thread, self._thread = self._thread, None
+        if loop_started:
             self._httpd.shutdown()
         self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=thread_join_timeout_s)
-            if self._thread.is_alive():
+        if thread is not None:
+            thread.join(timeout=thread_join_timeout_s)
+            if thread.is_alive():
                 logger.warning(
                     "server thread %r still alive after %.1fs join "
                     "(daemon=%s) — leaking it; a handler is wedged "
                     "past its timeouts",
-                    self._thread.name, thread_join_timeout_s,
-                    self._thread.daemon,
+                    thread.name, thread_join_timeout_s, thread.daemon,
                 )
                 result["server_thread_stopped"] = False
                 result["server_thread"] = {
-                    "name": self._thread.name,
-                    "daemon": self._thread.daemon,
+                    "name": thread.name,
+                    "daemon": thread.daemon,
                 }
-            self._thread = None
         self.batcher.close()
         self.registry.close()
         return result

@@ -222,8 +222,8 @@ class CostRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._costs: t.Dict[str, dict] = {}
-        self._errors: t.Dict[str, str] = {}
+        self._costs: t.Dict[str, dict] = {}  # guarded-by: _lock
+        self._errors: t.Dict[str, str] = {}  # guarded-by: _lock
 
     def register(self, name: str, cost: t.Mapping[str, float]) -> None:
         with self._lock:

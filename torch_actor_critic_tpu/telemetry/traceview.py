@@ -124,7 +124,9 @@ class RequestSpanLog:
     the same contract as ``telemetry=None``."""
 
     def __init__(self, capacity: int = 2048):
-        self._records: collections.deque = collections.deque(maxlen=capacity)
+        self._records: collections.deque = (  # guarded-by: _lock
+            collections.deque(maxlen=capacity)
+        )
         self._lock = threading.Lock()
 
     def record(self, rec: dict) -> None:
