@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke decouple-smoke
+.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke decouple-smoke visual-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -125,6 +125,15 @@ fleet-smoke:
 # --max-actor-lag (docs/RESILIENCE.md "Decoupled-plane failure modes").
 decouple-smoke:
 	JAX_PLATFORMS=cpu python scripts/decouple_smoke.py
+
+# Mixed-precision + fused-pixel-pipeline smoke (CPU, real CLI):
+# Pallas pixel-kernel interpret-vs-reference bit parity, f32 fused
+# pipeline bitwise vs the reference run, bf16 fused visual training
+# finite, cost/epoch_mfu present in metrics.jsonl and cost events
+# carrying the compute dtype (docs/SCALING.md "Mixed precision & the
+# pixel pipeline").
+visual-smoke:
+	JAX_PLATFORMS=cpu python scripts/visual_smoke.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -26,9 +26,16 @@ import sys
 
 # Performance-shaped keys and their regression direction. Matched
 # against the LEAF key name only (paths locate, names classify).
+# mfu/cost-family keys are higher-is-better: `_mfu$` covers both the
+# bench stages' `mfu`/`est_mfu` and the metrics.jsonl roofline columns
+# (`cost/epoch_mfu`, `cost/update_burst_mfu` — the leaf name keeps its
+# `cost/` prefix, the suffix classifies); `gflops_s$` covers the
+# achieved-FLOP/s columns (`cost/*_achieved_gflops_s`). An MFU drop
+# now regresses `make bench-diff` exactly like a goodput drop.
 HIGHER_BETTER = re.compile(
     r"(per_sec|_rps$|tflops|^mfu$|_mfu$|^est_mfu$|goodput|occupancy"
-    r"|^value$|^value_bf16$|scaling_vs_1|roofline_frac)"
+    r"|^value$|^value_bf16$|scaling_vs_1|roofline_frac|gflops_s$"
+    r"|hbm_util$)"
 )
 LOWER_BETTER = re.compile(
     r"(^p50_ms$|^p95_ms$|^p99_ms$|^mean_ms$|^max_ms$|_ms$"

@@ -1,0 +1,196 @@
+"""CPU smoke for the mixed-precision + fused-pixel-pipeline path.
+
+``make visual-smoke`` — the full pipeline, through the REAL CLI
+(docs/SCALING.md "Mixed precision & the pixel pipeline"):
+
+1. Fused-kernel parity: the Pallas pixel kernel (interpret mode)
+   agrees bitwise with its jnp reference across dtype/augment combos.
+2. f32 fallback is bitwise: an on-device pixel run with
+   ``--precision f32 --pixel-pipeline fused`` reproduces the default
+   (reference-pipeline) run's loss/reward stream exactly, same seed —
+   the fused gather moves the decode, never the numbers.
+3. bf16 fused visual training runs finite end-to-end
+   (``--precision bf16 --pixel-pipeline fused --frame-augment shift``)
+   with telemetry on.
+4. ``cost/epoch_mfu`` is present and finite in the bf16 run's
+   metrics.jsonl, and its `cost` telemetry events carry the compute
+   dtype — the visual-MFU regression detector is armed.
+
+Exit 0 on success, 1 with a message on any failure.
+"""
+
+import json
+import os
+import pathlib
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# CPU has no table entry for roofline peaks; pin the denominators so
+# cost/epoch_mfu exists and is deterministic (the cost-smoke pattern).
+os.environ.setdefault("TAC_PEAK_FLOPS", "1e12")
+os.environ.setdefault("TAC_PEAK_BW", "1e11")
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+FAILURES = []
+
+
+def check(ok, msg):
+    print(("ok  " if ok else "FAIL") + " " + msg)
+    if not ok:
+        FAILURES.append(msg)
+
+
+def kernel_parity():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torch_actor_critic_tpu.ops.augment import shift_offsets
+    from torch_actor_critic_tpu.ops.pixels import fused_frame_gather
+
+    import functools
+
+    ring = jax.random.randint(
+        jax.random.key(0), (32, 12, 20, 3), 0, 256, jnp.uint8
+    )
+    idx = jnp.array([0, 31, 7, 7], jnp.int32)
+
+    # One jitted wrapper, bound once; the per-combo knobs are static
+    # args (comparison runs under jit because that is where production
+    # sampling runs — see tests/test_pixels.py on the /255 rewrite).
+    @functools.partial(
+        jax.jit, static_argnames=("out_dtype", "impl", "interpret")
+    )
+    def gather(r, i, offsets, out_dtype, impl, interpret=False):
+        return fused_frame_gather(
+            r, i, offsets=offsets, pad=4, normalize=True,
+            out_dtype=out_dtype, frame_stack=2, impl=impl,
+            interpret=interpret,
+        )
+
+    for out_dtype in (jnp.float32, jnp.bfloat16):
+        for augment in (False, True):
+            offs = (
+                shift_offsets(jax.random.key(1), 4, 4) if augment else None
+            )
+            ref = gather(ring, idx, offs, out_dtype, "xla")
+            pal = gather(ring, idx, offs, out_dtype, "pallas",
+                         interpret=True)
+            same = np.array_equal(
+                np.asarray(ref, np.float32), np.asarray(pal, np.float32)
+            )
+            check(
+                same,
+                f"kernel parity {jnp.dtype(out_dtype).name} "
+                f"augment={augment}: interpret == reference bitwise",
+            )
+
+
+def run_train(root, run_name, extra):
+    from torch_actor_critic_tpu import train
+
+    argv = [
+        "--environment", "PixelPendulum-v0",
+        "--on-device", "true",
+        "--runs-root", str(root),
+        "--experiment", run_name,
+        "--seed", "7",
+        "--epochs", "2",
+        "--steps-per-epoch", "100",
+        "--update-every", "50",
+        "--start-steps", "50",
+        "--on-device-envs", "4",
+        "--buffer-size", "2000",
+        "--batch-size", "16",
+        "--hidden-sizes", "32,32",
+        "--filters", "16,32",
+        "--kernel-sizes", "4,3",
+        "--strides", "2,2",
+        "--cnn-dense-size", "64",
+        "--cnn-features", "16",
+        "--normalize-pixels", "true",
+        "--no-preemption-guard",
+    ] + extra
+    train.main(argv)
+    # One run dir per experiment root in this smoke.
+    runs = sorted((root / run_name).glob("*/metrics.jsonl"))
+    assert runs, f"no metrics.jsonl under {root / run_name}"
+    rows = [
+        json.loads(line)
+        for line in runs[-1].read_text().splitlines() if line.strip()
+    ]
+    tele = runs[-1].parent / "telemetry.jsonl"
+    events = (
+        [json.loads(x) for x in tele.read_text().splitlines() if x.strip()]
+        if tele.exists() else []
+    )
+    return rows, events
+
+
+def loss_stream(rows):
+    return [
+        (r.get("loss_q"), r.get("loss_pi"), r.get("reward"), r.get("episodes"))
+        for r in rows
+    ]
+
+
+def main():
+    kernel_parity()
+
+    import numpy as np
+
+    with tempfile.TemporaryDirectory() as td:
+        root = pathlib.Path(td)
+        # 2. Bitwise f32 fallback: default (reference pipeline) vs the
+        # fused pipeline at --precision f32, same seed.
+        ref_rows, _ = run_train(root, "ref_f32", [])
+        fus_rows, _ = run_train(
+            root, "fused_f32",
+            ["--precision", "f32", "--pixel-pipeline", "fused"],
+        )
+        check(
+            loss_stream(ref_rows) == loss_stream(fus_rows),
+            "f32 fused pipeline bitwise-matches the reference pipeline "
+            "loss/reward stream through the real CLI",
+        )
+
+        # 3./4. bf16 + fused + DrQ shift, telemetry on -> finite losses
+        # and the cost/mfu regression detector present.
+        bf_rows, bf_events = run_train(
+            root, "fused_bf16",
+            [
+                "--precision", "bf16", "--pixel-pipeline", "fused",
+                "--frame-augment", "shift", "--telemetry", "true",
+            ],
+        )
+        finite = all(
+            np.isfinite(r["loss_q"]) and np.isfinite(r["loss_pi"])
+            for r in bf_rows
+        )
+        check(finite and len(bf_rows) == 2,
+              "bf16 fused visual training finite over 2 epochs")
+        mfu = [r.get("cost/epoch_mfu") for r in bf_rows if "cost/epoch_mfu" in r]
+        check(
+            bool(mfu) and all(np.isfinite(v) and v > 0 for v in mfu),
+            "cost/epoch_mfu present and finite in metrics.jsonl",
+        )
+        cost_events = [e for e in bf_events if e.get("type") == "cost"]
+        check(
+            bool(cost_events)
+            and all(
+                e.get("compute_dtype") == "bfloat16" for e in cost_events
+            ),
+            "cost telemetry events carry the compute dtype",
+        )
+
+    if FAILURES:
+        print(f"\nvisual-smoke: {len(FAILURES)} failure(s)")
+        return 1
+    print("\nvisual-smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

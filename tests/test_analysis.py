@@ -122,6 +122,90 @@ def test_reachability_through_scan_and_helpers():
     assert rules_of(findings) == ["wallclock-in-jit"]
 
 
+def test_frame_f32_materialize_astype_flagged():
+    findings = lint_one("""
+        import jax.numpy as jnp
+
+        def stage(batch):
+            return batch.states.frame.astype(jnp.float32)
+    """)
+    assert rules_of(findings) == ["frame-f32-materialize"]
+
+
+def test_frame_f32_materialize_div255_flagged():
+    findings = lint_one("""
+        def decode(frames):
+            return frames / 255.0
+    """)
+    assert rules_of(findings) == ["frame-f32-materialize"]
+
+
+def test_frame_rule_negatives():
+    # Non-frame casts, uint8 frame moves, and activation casts (a
+    # name without 'frame') are all fine.
+    findings = lint_one("""
+        import jax.numpy as jnp
+
+        def ok(batch, frames, x):
+            a = batch.rewards.astype(jnp.float32)
+            b = frames.astype(jnp.uint8)
+            c = x / 255.0
+            d = x.astype(jnp.float32)
+            return a, b, c, d
+    """)
+    assert findings == []
+
+
+def test_frame_decode_home_is_exempt():
+    findings = lint_one(
+        """
+        import jax.numpy as jnp
+
+        def _decode(frame):
+            return frame.astype(jnp.float32) / 255.0
+        """,
+        path="torch_actor_critic_tpu/ops/pixels.py",
+    )
+    assert findings == []
+
+
+def test_frame_decode_allowlist_scope_and_staleness():
+    # The allowlisted SimpleCNN.__call__ decode passes; the same file
+    # WITHOUT the decode trips stale-allowlist (checked, never
+    # trusted — the shard-map precedent).
+    allowed = lint_one(
+        """
+        import jax.numpy as jnp
+
+        class SimpleCNN:
+            def __call__(self, frame):
+                return frame.astype(jnp.float32)
+        """,
+        path="torch_actor_critic_tpu/models/visual.py",
+    )
+    assert allowed == []
+    stale = lint_one(
+        "X = 1\n",
+        path="torch_actor_critic_tpu/models/visual.py",
+    )
+    assert "stale-allowlist" in rules_of(stale)
+    # An un-allowlisted scope in the same file is still flagged.
+    elsewhere = lint_one(
+        """
+        import jax.numpy as jnp
+
+        class SimpleCNN:
+            def __call__(self, frame):
+                return frame.astype(jnp.float32)
+
+        def other(frames):
+            return frames / 255.0
+        """,
+        path="torch_actor_critic_tpu/models/visual.py",
+    )
+    assert "frame-f32-materialize" in rules_of(elsewhere)
+
+
 def test_stale_entry_point_reported_on_package_runs():
     # A "package" (root __init__ present) whose seed table files are
     # gone must fail loudly instead of the walk silently going blind.

@@ -52,6 +52,52 @@ def test_independent_offsets_per_example_and_per_call():
     assert (out2 != out).any()  # fresh draw per call
 
 
+def test_random_shift_large_pad_edge_replicates():
+    """pad >= frame//2: offsets can push the crop entirely into the
+    edge-replicated band. Shapes/dtype hold, values stay a subset of
+    the original frame's (replication invents no pixels), and the
+    extreme offsets are reachable."""
+    h = w = 16
+    pad = h // 2  # 8 — offsets span [0, 16] on a 16px frame
+    f = _frames(jax.random.key(0), b=16, h=h, w=w)
+    out = random_shift(f, jax.random.key(1), pad=pad)
+    assert out.shape == f.shape and out.dtype == jnp.uint8
+    for i in range(16):
+        assert set(np.unique(out[i])) <= set(np.unique(f[i]))
+    # The fused pipeline's clipped-index gather must agree with the
+    # pad+crop spelling at this extreme pad too (same key, same
+    # offsets — ops/pixels pins pad=4; this is the pad >= frame//2
+    # edge).
+    from torch_actor_critic_tpu.ops.augment import shift_offsets
+    from torch_actor_critic_tpu.ops.pixels import gather_frames_reference
+
+    got = gather_frames_reference(
+        f, jnp.arange(16, dtype=jnp.int32),
+        offsets=shift_offsets(jax.random.key(1), 16, pad), pad=pad,
+        out_dtype=jnp.float32,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(out).astype(np.float32)
+    )
+
+
+def test_random_shift_non_square_frames():
+    f = jax.random.randint(jax.random.key(2), (5, 12, 20, 3), 0, 256,
+                           dtype=jnp.uint8)
+    out = random_shift(f, jax.random.key(3), pad=4)
+    assert out.shape == f.shape and out.dtype == jnp.uint8
+    assert (np.asarray(out) != np.asarray(f)).any()
+
+
+def test_random_shift_deterministic_under_fixed_key():
+    f = _frames(jax.random.key(4), b=6)
+    a = random_shift(f, jax.random.key(5), pad=4)
+    b = random_shift(f, jax.random.key(5), pad=4)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = random_shift(f, jax.random.key(6), pad=4)
+    assert (np.asarray(c) != np.asarray(a)).any()
+
+
 def _visual_batch(key, b=4):
     ks = jax.random.split(key, 4)
     mo = lambda k: MultiObservation(

@@ -508,6 +508,70 @@ def test_bench_diff_flags_regressions(tmp_path):
     assert "serving.requests_per_sec" not in regressed
 
 
+def test_bench_diff_mfu_and_cost_keys_are_higher_better():
+    """MFU/cost-family regressions flag exactly like goodput (the
+    visual-MFU tentpole's regression detector): bench `mfu` leaves at
+    any nesting depth, metrics.jsonl roofline columns
+    (cost/epoch_mfu, cost/*_achieved_gflops_s) and roofline_frac."""
+    bd = _load_bench_diff()
+    a = {
+        "visual": {
+            "mfu": 0.18,
+            "bf16_fused": {"mfu": 0.21, "grad_steps_per_sec": 900.0},
+        },
+        "cost/epoch_mfu": 0.15,
+        "cost/update_burst_achieved_gflops_s": 120.0,
+        "roofline_frac": 0.5,
+    }
+    b = {
+        "visual": {
+            "mfu": 0.02,  # -89%: THE regression this PR exists to stop
+            "bf16_fused": {"mfu": 0.20, "grad_steps_per_sec": 880.0},
+        },
+        "cost/epoch_mfu": 0.05,
+        "cost/update_burst_achieved_gflops_s": 40.0,
+        "roofline_frac": 0.45,
+    }
+    rows, regressions = bd.compare(a, b, noise_pct=10.0)
+    regressed = {r[0] for r in regressions}
+    assert "visual.mfu" in regressed
+    assert "cost/epoch_mfu" in regressed
+    assert "cost/update_burst_achieved_gflops_s" in regressed
+    assert "visual.bf16_fused.mfu" not in regressed  # within noise
+    # And an IMPROVED mfu must not regress.
+    _, regs_up = bd.compare(b, a, noise_pct=10.0)
+    assert not {r[0] for r in regs_up}
+
+
+def test_bench_stage_budget_scales_to_enforced_timeout(monkeypatch):
+    """BENCH_r05 fix: a stage's internal budget scales to the enforced
+    per-stage timeout so the stage self-terminates (emitting its JSON)
+    inside the parent's hard kill window."""
+    bench_path = Path(__file__).resolve().parents[1] / "bench.py"
+    spec = importlib.util.spec_from_file_location("bench_mod2", bench_path)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    monkeypatch.delenv("TAC_BENCH_STAGE_BUDGET", raising=False)
+    assert bench.stage_budget(600.0) == 600.0
+    monkeypatch.setenv("TAC_BENCH_STAGE_BUDGET", "200")
+    assert bench.stage_budget(600.0) == pytest.approx(140.0)  # 0.7 * 200
+    assert bench.stage_budget(100.0) == 100.0  # default already fits
+
+    # Per-point subdivision: completed points stream as structured
+    # [bench-point] lines that a killed stage's parent reassembles.
+    stderr = "\n".join([
+        "[bench] sweep batch=64 ...",
+        '[bench-point] {"stage": "sweep", "entry": {"batch": 64, '
+        '"grad_steps_per_sec": 10.0}}',
+        '[bench-point] {"stage": "sweep", "entry": {"batch": 512, '
+        '"grad_steps_per_sec": 9.0}}',
+        "[bench-point] not json — ignored",
+    ])
+    points = bench.collect_points((None, stderr))
+    assert [e["batch"] for e in points["sweep"]] == [64, 512]
+
+
 def test_bench_diff_recovers_truncated_wrapper(tmp_path):
     """A BENCH_rNN capture wrapper whose tail lost its line start still
     yields its trailing sections for comparison."""

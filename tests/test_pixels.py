@@ -1,0 +1,375 @@
+"""Fused pixel pipeline (ops/pixels.py + pixel_pipeline="fused") and
+the mixed-precision training policy.
+
+The contract under test (docs/SCALING.md "Mixed precision & the pixel
+pipeline"):
+
+- the Pallas kernel (interpret mode), the jnp reference and the legacy
+  pad/crop augmentation all agree BITWISE;
+- ``pixel_pipeline="fused"`` at f32 with ``frame_augment="none"`` is
+  bitwise-identical to the reference path per update — flipping the
+  flag moves the decode, never the numbers;
+- bf16 training is finite and tracks the f32 loss trajectory within
+  tolerance (f32 master weights; only matmul/conv compute narrows);
+- the fused sample provably materializes NO f32 frame batch (jaxpr
+  scan + byte accounting) — the property the frame-f32-materialize
+  lint guards at the source level.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_actor_critic_tpu.buffer import (
+    init_visual_replay_buffer,
+    push,
+    sample_fused_visual,
+)
+from torch_actor_critic_tpu.core.types import Batch, MultiObservation
+from torch_actor_critic_tpu.ops.augment import random_shift, shift_offsets
+from torch_actor_critic_tpu.ops.pixels import (
+    fused_frame_gather,
+    gather_frames_reference,
+    stack_rows,
+)
+from torch_actor_critic_tpu.sac.trainer import build_models, make_learner
+from torch_actor_critic_tpu.utils.config import SACConfig
+
+CAP, H, W, C = 64, 12, 20, 3  # non-square on purpose
+
+
+def _ring(key, cap=CAP, h=H, w=W, c=C):
+    return jax.random.randint(key, (cap, h, w, c), 0, 256, jnp.uint8)
+
+
+# ------------------------------------------------------------ semantics
+
+
+def test_reference_matches_pad_crop_shift():
+    """The clipped-index gather is the SAME augmentation as
+    ops/augment.random_shift's edge-pad + crop, offset for offset."""
+    ring = _ring(jax.random.key(0))
+    idx = jnp.array([3, 0, 63, 17], jnp.int32)
+    key = jax.random.key(1)
+    pad = 4
+    frames = jnp.take(ring, idx, axis=0)
+    legacy = random_shift(frames, key, pad=pad)  # draws offsets from key
+    got = gather_frames_reference(
+        ring, idx, offsets=shift_offsets(key, 4, pad), pad=pad,
+        out_dtype=jnp.float32,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(legacy).astype(np.float32)
+    )
+
+
+def test_reference_no_augment_is_gather_plus_decode():
+    ring = _ring(jax.random.key(2))
+    idx = jnp.array([5, 5, 1], jnp.int32)
+    got = gather_frames_reference(
+        ring, idx, normalize=True, out_dtype=jnp.float32
+    )
+    want = jnp.take(ring, idx, axis=0).astype(jnp.float32) / 255.0
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_stack_rows_wraps_modularly():
+    rows = stack_rows(jnp.array([0, 2], jnp.int32), 3, CAP)
+    np.testing.assert_array_equal(
+        np.asarray(rows), [[CAP - 2, CAP - 1, 0], [0, 1, 2]]
+    )
+
+
+def test_frame_stack_concatenates_on_channels_newest_last():
+    ring = _ring(jax.random.key(3))
+    idx = jnp.array([10], jnp.int32)
+    got = gather_frames_reference(ring, idx, frame_stack=3)
+    assert got.shape == (1, H, W, 3 * C)
+    np.testing.assert_array_equal(
+        np.asarray(got[0, :, :, 2 * C:]),
+        np.asarray(ring[10]).astype(np.float32),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got[0, :, :, :C]),
+        np.asarray(ring[8]).astype(np.float32),
+    )
+
+
+# ------------------------------------------------- kernel bit parity
+
+
+@pytest.mark.parametrize("out_dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("normalize", [False, True])
+@pytest.mark.parametrize("augment", [False, True])
+@pytest.mark.parametrize("frame_stack", [1, 3])
+def test_pallas_kernel_bitwise_matches_reference(
+    out_dtype, normalize, augment, frame_stack
+):
+    ring = _ring(jax.random.key(4))
+    idx = jnp.array([0, 7, 63, 31, 31], jnp.int32)
+    pad = 3
+    offsets = (
+        shift_offsets(jax.random.key(5), 5, pad) if augment else None
+    )
+    kw = dict(
+        offsets=offsets, pad=pad, normalize=normalize,
+        out_dtype=out_dtype, frame_stack=frame_stack,
+    )
+    # Compare under jit: that is where production sampling runs, and
+    # XLA's divide-by-constant rewrite makes jitted /255 differ from
+    # the eager spelling by 1 ULP — a compiler property, not a kernel
+    # one.
+    ref = jax.jit(
+        lambda r, i: fused_frame_gather(r, i, impl="xla", **kw)
+    )(ring, idx)
+    pallas = jax.jit(
+        lambda r, i: fused_frame_gather(
+            r, i, impl="pallas", interpret=True, **kw
+        )
+    )(ring, idx)
+    assert pallas.dtype == out_dtype
+    np.testing.assert_array_equal(
+        np.asarray(pallas, np.float32), np.asarray(ref, np.float32)
+    )
+
+
+def test_pallas_on_cpu_without_interpret_raises():
+    if jax.default_backend() == "tpu":
+        pytest.skip("guard is for non-TPU processes")
+    ring = _ring(jax.random.key(6))
+    with pytest.raises(RuntimeError, match="default backend"):
+        fused_frame_gather(ring, jnp.array([0], jnp.int32), impl="pallas")
+
+
+def test_non_uint8_ring_rejected():
+    with pytest.raises(ValueError, match="uint8"):
+        fused_frame_gather(
+            jnp.zeros((4, 8, 8, 3), jnp.float32), jnp.array([0], jnp.int32)
+        )
+
+
+def test_fused_gather_deterministic_under_fixed_inputs():
+    ring = _ring(jax.random.key(7))
+    idx = jnp.array([1, 2, 3], jnp.int32)
+    offs = shift_offsets(jax.random.key(8), 3, 4)
+    a = fused_frame_gather(ring, idx, offsets=offs)
+    b = fused_frame_gather(ring, idx, offsets=offs)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -------------------------------------------- training-path integration
+
+FEAT, ACT, FRAME = 4, 2, (16, 16, 3)
+
+
+class _Spec:
+    obs_spec = MultiObservation(
+        features=jax.ShapeDtypeStruct((FEAT,), jnp.float32),
+        frame=jax.ShapeDtypeStruct(FRAME, jnp.uint8),
+    )
+    act_dim = ACT
+    act_limit = 1.0
+
+
+def _cfg(**kw):
+    base = dict(
+        hidden_sizes=(16, 16), batch_size=8,
+        filters=(8,), kernel_sizes=(4,), strides=(2,),
+        cnn_dense_size=16, cnn_features=4, normalize_pixels=True,
+    )
+    base.update(kw)
+    return SACConfig(**base)
+
+
+def _chunk(seed, n=32):
+    ks = jax.random.split(jax.random.key(seed), 6)
+    mo = lambda kf, kp: MultiObservation(  # noqa: E731
+        features=jax.random.normal(kf, (n, FEAT)),
+        frame=jax.random.randint(kp, (n, *FRAME), 0, 256, jnp.uint8),
+    )
+    return Batch(
+        states=mo(ks[0], ks[1]),
+        actions=jnp.tanh(jax.random.normal(ks[2], (n, ACT))),
+        rewards=jax.random.normal(ks[3], (n,)),
+        next_states=mo(ks[4], ks[5]),
+        done=jnp.zeros((n,)),
+    )
+
+
+def _burst(cfg, num_updates=5):
+    actor, critic = build_models(cfg, _Spec)
+    learner = make_learner(cfg, actor, critic, ACT)
+    zero = MultiObservation(
+        features=jnp.zeros((FEAT,)), frame=jnp.zeros(FRAME, jnp.uint8)
+    )
+    state = learner.init_state(jax.random.key(0), zero)
+    buf = init_visual_replay_buffer(200, FEAT, FRAME, ACT)
+    fn = jax.jit(learner.update_burst, static_argnums=(3,))
+    return fn(state, buf, _chunk(1), num_updates)
+
+
+def test_fused_f32_bitwise_equals_reference_pipeline():
+    """THE precision/pipeline pin: at f32 with frame_augment='none',
+    pixel_pipeline='fused' produces bit-identical learner state and
+    metrics to the reference path — the fused gather decodes exactly
+    what the model used to decode."""
+    s_ref, _, m_ref = _burst(_cfg(pixel_pipeline="reference"))
+    s_fus, _, m_fus = _burst(_cfg(pixel_pipeline="fused"))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(
+            (s_ref.actor_params, s_ref.critic_params,
+             s_ref.target_critic_params, m_ref)
+        ),
+        jax.tree_util.tree_leaves(
+            (s_fus.actor_params, s_fus.critic_params,
+             s_fus.target_critic_params, m_fus)
+        ),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_f32_default_rng_stream_unchanged_by_pipeline_feature():
+    """precision=f32 parity pin: the default (reference-pipeline)
+    update consumes the historical 3-way rng split — the fused-pixel
+    feature's existence must not move anyone's PRNG stream."""
+    s, _, _ = _burst(_cfg(), num_updates=1)
+    # One burst-level split + one update-level 3-way split from the
+    # initial state rng.
+    state0_rng = make_state_rng()
+    rng_after_sample = jax.random.split(state0_rng)[0]
+    want = jax.random.split(rng_after_sample, 3)[0]
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(s.rng)),
+        np.asarray(jax.random.key_data(want)),
+    )
+
+
+def make_state_rng():
+    """The rng leaf init_state derives for seed key(0) — recomputed
+    independently of the learner class."""
+    _, _, _, k_state = jax.random.split(jax.random.key(0), 4)
+    return k_state
+
+
+def test_bf16_fused_training_finite_and_tracks_f32():
+    """bf16 compute with f32 master weights: the fused bf16 loss
+    trajectory stays finite and within tolerance of the f32 one over a
+    multi-update burst (loss-scale-free: bf16 keeps f32's exponent)."""
+    _, _, m32 = _burst(_cfg(pixel_pipeline="fused", frame_augment="shift"),
+                       num_updates=10)
+    _, _, mbf = _burst(
+        _cfg(pixel_pipeline="fused", frame_augment="shift",
+             compute_dtype="bfloat16"),
+        num_updates=10,
+    )
+    for key in ("loss_q", "loss_pi"):
+        a, b = float(m32[key]), float(mbf[key])
+        assert np.isfinite(a) and np.isfinite(b)
+        assert abs(a - b) <= 0.25 * abs(a) + 0.1, (key, a, b)
+
+
+def test_td3_rides_the_fused_pipeline():
+    s, _, m = _burst(
+        _cfg(pixel_pipeline="fused", compute_dtype="bfloat16",
+             algorithm="td3", frame_augment="shift"),
+        num_updates=4,
+    )
+    assert int(s.step) == 4
+    assert np.isfinite(float(m["loss_q"]))
+
+
+# ------------------------------------- no-f32-materialization proof
+
+
+def _frame_shaped_f32(jaxpr, batch, hw):
+    """Recursively collect f32 frame-batch avals from a jaxpr."""
+    hits = []
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            if (
+                aval is not None
+                and getattr(aval, "dtype", None) == jnp.float32
+                and getattr(aval, "ndim", 0) == 4
+                and aval.shape[0] == batch
+                and aval.shape[1:3] == hw
+            ):
+                hits.append(aval)
+        for sub in eqn.params.values():
+            inner = getattr(sub, "jaxpr", None)
+            if inner is not None:
+                hits.extend(_frame_shaped_f32(inner, batch, hw))
+    return hits
+
+
+def test_fused_bf16_sample_materializes_no_f32_frames():
+    """Byte accounting + jaxpr proof: the bf16 fused sample's program
+    contains NO f32 frame-batch tensor anywhere (the decode casts
+    uint8 -> bf16 directly; integers <= 255 are exact in bf16), and
+    the sampled frame leaves carry half the f32 footprint."""
+    buf = init_visual_replay_buffer(64, FEAT, FRAME, ACT)
+    buf = push(buf, _chunk(2, n=32))
+    b = 8
+
+    def sample_fn(state, key):
+        return sample_fused_visual(
+            state, key, b, out_dtype=jnp.bfloat16, augment="shift",
+            pad=4, normalize=True,
+        )
+
+    jaxpr = jax.make_jaxpr(sample_fn)(buf, jax.random.key(0))
+    hits = _frame_shaped_f32(jaxpr.jaxpr, b, FRAME[:2])
+    assert hits == [], f"f32 frame batches in the fused sample: {hits}"
+
+    batch = sample_fn(buf, jax.random.key(0))
+    assert batch.states.frame.dtype == jnp.bfloat16
+    f32_bytes = b * FRAME[0] * FRAME[1] * FRAME[2] * 4
+    assert batch.states.frame.nbytes * 2 == f32_bytes
+    # The reference path's sampled frames stay uint8 (decode happens —
+    # and is allowlisted — inside the model).
+    from torch_actor_critic_tpu.buffer import sample
+
+    ref = sample(buf, jax.random.key(0), b)
+    assert ref.states.frame.dtype == jnp.uint8
+
+
+# ----------------------------------------------- config / CLI surface
+
+
+def test_pixel_pipeline_validation():
+    with pytest.raises(ValueError, match="pixel_pipeline"):
+        SACConfig(pixel_pipeline="pallas")
+
+    class FlatSpec:
+        obs_spec = jax.ShapeDtypeStruct((3,), jnp.float32)
+        act_dim = 1
+        act_limit = 1.0
+
+    with pytest.raises(ValueError, match="visual"):
+        build_models(SACConfig(pixel_pipeline="fused"), FlatSpec)
+
+
+def test_precision_aliases_normalize():
+    assert SACConfig(compute_dtype="bf16").compute_dtype == "bfloat16"
+    assert SACConfig(compute_dtype="f32").compute_dtype == "float32"
+    assert SACConfig(compute_dtype="bf16").model_dtype == jnp.bfloat16
+    with pytest.raises(ValueError, match="compute_dtype"):
+        SACConfig(compute_dtype="fp16")
+
+
+def test_precision_cli_flag_maps_to_compute_dtype():
+    from torch_actor_critic_tpu.train import config_from_args, parse_arguments
+
+    cfg = config_from_args(parse_arguments(["--precision", "bf16"]))
+    assert cfg.compute_dtype == "bfloat16"
+    cfg = config_from_args(
+        parse_arguments(["--precision", "bf16", "--compute-dtype", "bfloat16"])
+    )
+    assert cfg.compute_dtype == "bfloat16"
+    with pytest.raises(ValueError, match="conflicts"):
+        config_from_args(
+            parse_arguments(
+                ["--precision", "bf16", "--compute-dtype", "float32"]
+            )
+        )

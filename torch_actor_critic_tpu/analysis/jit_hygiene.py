@@ -20,6 +20,15 @@ hazards:
 * ``host-random-in-jit`` — stdlib ``random.*`` / ``np.random.*`` in
   traced code is the same bug for randomness (``jax.random`` with
   explicit keys is the traced-safe spelling and is never flagged).
+* ``frame-f32-materialize`` — ``astype(float32)`` or division by 255
+  applied to a frame-derived value outside the fused pixel pipeline
+  (``ops/pixels.py``, the decode's home) or the checked
+  :data:`FRAME_DECODE_ALLOWLIST`. Frames live in HBM as uint8 by
+  design (4x smaller replay, ``buffer/replay.py``); decoding them to
+  f32 anywhere but the fused gather re-creates the 4x-width frame
+  batch the pixel-pipeline work removed — the silent regression this
+  rule exists to stop. Like the shard-map allowlist, every entry must
+  still match a real decode (``stale-allowlist``).
 """
 
 from __future__ import annotations
@@ -38,9 +47,26 @@ from torch_actor_critic_tpu.analysis.walker import (
     dotted_name,
 )
 
-__all__ = ["check"]
+__all__ = ["check", "FRAME_DECODE_ALLOWLIST"]
 
 FAMILY = "jit-hygiene"
+
+# The fused pixel pipeline: uint8 frame decode lives here by
+# definition (both the Pallas kernel and its jnp reference path).
+FRAME_DECODE_HOME = ("ops/pixels.py",)
+
+# (path suffix, scope qualname) pairs allowed to decode uint8 frames
+# to f32 outside the pipeline home. Scope "*" means anywhere in the
+# file. Every entry must match at least one live decode or the run
+# fails with stale-allowlist. Justifications live in docs/ANALYSIS.md.
+FRAME_DECODE_ALLOWLIST: t.FrozenSet[t.Tuple[str, str]] = frozenset({
+    # The legacy in-model decode — pixel_pipeline="reference"'s
+    # bit-pinned parity path (uint8 frames cast + normalized inside
+    # SimpleCNN). It must keep existing verbatim: precision=f32 on the
+    # reference pipeline is graph- and bit-identical to the pre-fusion
+    # builds by contract.
+    ("models/visual.py", "SimpleCNN.__call__"),
+})
 
 # Attribute-call syncs flagged on ANY receiver inside traced code.
 _SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
@@ -158,6 +184,108 @@ def _walk_skipping(root: ast.AST, skip: t.Set[ast.AST]) -> t.Iterator[ast.AST]:
         stack.extend(ast.iter_child_nodes(node))
 
 
+# -------------------------------------------------- frame decode rule
+
+_F32_NAMES = frozenset({
+    "jnp.float32", "np.float32", "jax.numpy.float32", "numpy.float32",
+    "float32",
+})
+
+
+def _is_f32_spelling(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "float32":
+        return True
+    name = dotted_name(node)
+    return name is not None and name in _F32_NAMES
+
+
+def _is_255(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value in (255, 255.0)
+
+
+def _mentions_frame(node: ast.AST) -> bool:
+    """Does the expression DIRECTLY read a frame value — a name or
+    attribute spelled with 'frame' (``frames``, ``batch.states.frame``,
+    ``frame_batch``)? Deliberately no dataflow propagation: once frames
+    enter the network, everything downstream derives from them, and
+    casting *activations* to f32 is the mixed-precision policy (the
+    heads do exactly that), not a frame materialization."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and "frame" in n.id.lower():
+            return True
+        if isinstance(n, ast.Attribute) and "frame" in n.attr.lower():
+            return True
+    return False
+
+
+def _frame_scope_qualname(ctx, node: ast.AST) -> str:
+    fn = ctx.enclosing_function(node)
+    if fn is None:
+        return "<module>"
+    for info in ctx.functions:
+        if info.node is fn:
+            return info.qualname
+    return fn.name  # pragma: no cover - every function is indexed
+
+
+def _check_frame_decode(
+    project: Project,
+    findings: t.List[Finding],
+    emit: t.Callable,
+) -> None:
+    allow_hits: t.Set[t.Tuple[str, str]] = set()
+    for ctx in project.files:
+        if any(ctx.path.endswith(home) for home in FRAME_DECODE_HOME):
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                decoded = (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                    and len(node.args) == 1
+                    and _is_f32_spelling(node.args[0])
+                    and _mentions_frame(node.func.value)
+                )
+                what = "astype(float32)"
+            elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div):
+                decoded = _is_255(node.right) and _mentions_frame(node.left)
+                what = "division by 255"
+            else:
+                continue
+            if not decoded:
+                continue
+            scope = _frame_scope_qualname(ctx, node)
+            entry = next(
+                (
+                    e for e in FRAME_DECODE_ALLOWLIST
+                    if ctx.path.endswith(e[0]) and e[1] in (scope, "*")
+                ),
+                None,
+            )
+            if entry is not None:
+                allow_hits.add(entry)
+                continue
+            emit(
+                "frame-f32-materialize", ctx.path, node,
+                f"{what} on a frame-derived value materializes the "
+                "4x-width f32 frame batch the fused pixel pipeline "
+                "exists to avoid (frames are uint8 in HBM by design)",
+                "route sampling through pixel_pipeline='fused' "
+                "(ops/pixels.py decodes in-kernel), or add a justified "
+                "entry to FRAME_DECODE_ALLOWLIST (analysis/"
+                "jit_hygiene.py) and docs/ANALYSIS.md",
+            )
+    for entry in sorted(FRAME_DECODE_ALLOWLIST - allow_hits):
+        if any(f.path.endswith(entry[0]) for f in project.files):
+            findings.append(Finding(
+                "stale-allowlist", entry[0], 1, 0,
+                f"frame-decode allowlist entry {entry!r} matches no "
+                "decode; the code it excused is gone",
+                "remove the entry from analysis/jit_hygiene.py "
+                "FRAME_DECODE_ALLOWLIST",
+            ))
+
+
 def check(project: Project) -> t.List[Finding]:
     findings: t.List[Finding] = []
     seen: t.Set[t.Tuple[str, int, int, str]] = set()
@@ -172,6 +300,7 @@ def check(project: Project) -> t.List[Finding]:
         )
 
     findings.extend(project.entry_point_findings())
+    _check_frame_decode(project, findings, emit)
 
     for (path, _), fn in sorted(
         project.traced().items(), key=lambda kv: (kv[0][0], kv[0][1])

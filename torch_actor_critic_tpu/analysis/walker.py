@@ -41,6 +41,7 @@ RULE_FAMILIES: t.Dict[str, t.Tuple[str, ...]] = {
         "wallclock-in-jit",
         "host-random-in-jit",
         "stale-entry-point",
+        "frame-f32-materialize",
     ),
     "recompile-risk": (
         "jit-cache-discard",

@@ -3,4 +3,5 @@ from torch_actor_critic_tpu.buffer.replay import (  # noqa: F401
     init_visual_replay_buffer,
     push,
     sample,
+    sample_fused_visual,
 )

@@ -202,3 +202,72 @@ def sample(state: BufferState, key: jax.Array, batch_size: int) -> Batch:
         raise ValueError("sample: replay buffer is empty (size == 0).")
     idx = jax.random.randint(key, (batch_size,), 0, jnp.maximum(state.size, 1))
     return jax.tree_util.tree_map(lambda ring: jnp.take(ring, idx, axis=0), state.data)
+
+
+def sample_fused_visual(
+    state: BufferState,
+    key: jax.Array,
+    batch_size: int,
+    out_dtype,
+    augment: str = "none",
+    pad: int = 4,
+    normalize: bool = False,
+    impl: str = "auto",
+    interpret: bool = False,
+) -> Batch:
+    """:func:`sample` for visual batches through the fused pixel
+    pipeline (``ops/pixels.py``): non-frame leaves gather exactly like
+    :func:`sample`; the two frame leaves decode, DrQ-shift and cast to
+    ``out_dtype`` inside the fused gather, so the sampled frame batch
+    never materializes as float32 in HBM (bf16 halves its footprint
+    besides).
+
+    Key discipline: with ``augment="none"`` the row draw consumes
+    ``key`` exactly as :func:`sample` does, so at ``out_dtype=float32``
+    this path is bitwise-identical to sample-then-decode-in-model —
+    the ``pixel_pipeline="fused"`` f32 equivalence tests pin it. With
+    ``augment="shift"`` the key splits three ways (rows, state shift,
+    next-state shift): augmentation keys are consumed at sample time
+    instead of inside the learner update (DrQ's independent
+    per-example, per-use draws preserved).
+    """
+    from torch_actor_critic_tpu.ops.augment import shift_offsets
+    from torch_actor_critic_tpu.ops.pixels import fused_frame_gather
+
+    if not isinstance(state.data.states, MultiObservation):
+        raise ValueError(
+            "sample_fused_visual needs a MultiObservation (frame) "
+            f"buffer; got {type(state.data.states).__name__}"
+        )
+    if not isinstance(state.size, jax.core.Tracer) and int(state.size) == 0:
+        raise ValueError("sample: replay buffer is empty (size == 0).")
+    if augment == "shift":
+        k_idx, k_s, k_n = jax.random.split(key, 3)
+        offs_s = shift_offsets(k_s, batch_size, pad)
+        offs_n = shift_offsets(k_n, batch_size, pad)
+    elif augment == "none":
+        k_idx, offs_s, offs_n = key, None, None
+    else:
+        raise ValueError(f"unknown frame_augment mode {augment!r}")
+    idx = jax.random.randint(
+        k_idx, (batch_size,), 0, jnp.maximum(state.size, 1)
+    )
+    take = lambda ring: jnp.take(ring, idx, axis=0)  # noqa: E731
+    gather = lambda ring, offs: fused_frame_gather(  # noqa: E731
+        ring, idx, offsets=offs, pad=pad, normalize=normalize,
+        out_dtype=out_dtype, impl=impl, interpret=interpret,
+    )
+    d = state.data
+    return Batch(
+        states=MultiObservation(
+            features=take(d.states.features),
+            frame=gather(d.states.frame, offs_s),
+        ),
+        actions=take(d.actions),
+        rewards=take(d.rewards),
+        next_states=MultiObservation(
+            features=take(d.next_states.features),
+            frame=gather(d.next_states.frame, offs_n),
+        ),
+        done=take(d.done),
+    )

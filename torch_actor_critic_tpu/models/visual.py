@@ -90,9 +90,20 @@ class SimpleCNN(nn.Module):
     @nn.compact
     def __call__(self, frame: jax.Array) -> jax.Array:
         dtype = self.dtype
-        x = frame.astype(jnp.float32)
-        if self.normalize_pixels:
-            x = x / 255.0
+        if jnp.issubdtype(frame.dtype, jnp.floating):
+            # Fused pixel pipeline (ops/pixels.py): the frame batch
+            # arrives already decoded, normalized and cast to the
+            # compute dtype at sample time — decoding again here would
+            # double-normalize. Float frames are, by contract,
+            # pre-processed.
+            x = frame
+        else:
+            # Legacy in-model decode — the bit-pinned reference path
+            # (tac-lint frame-f32-materialize allowlists exactly this
+            # site; new uint8->f32 frame decodes belong in ops/pixels).
+            x = frame.astype(jnp.float32)
+            if self.normalize_pixels:
+                x = x / 255.0
         for i, (f, k, s) in enumerate(
             zip(self.filters, self.kernel_sizes, self.strides)
         ):

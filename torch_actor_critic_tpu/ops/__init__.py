@@ -11,3 +11,7 @@ from torch_actor_critic_tpu.ops.attention import (  # noqa: F401
     flash_attention,
     reference_attention,
 )
+from torch_actor_critic_tpu.ops.pixels import (  # noqa: F401
+    fused_frame_gather,
+    gather_frames_reference,
+)

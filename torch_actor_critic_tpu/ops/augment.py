@@ -22,7 +22,17 @@ import jax.numpy as jnp
 
 from torch_actor_critic_tpu.core.types import Batch, MultiObservation
 
-__all__ = ["random_shift", "augment_batch"]
+__all__ = ["random_shift", "augment_batch", "shift_offsets"]
+
+
+def shift_offsets(key: jax.Array, n: int, pad: int = 4) -> jax.Array:
+    """The DrQ shift draw: ``(n, 2)`` per-example crop offsets, uniform
+    over ``[0, 2*pad]``. The ONE definition shared by
+    :func:`random_shift` (pad-then-crop) and the fused pixel pipeline
+    (:mod:`torch_actor_critic_tpu.ops.pixels`, clipped-index gather),
+    so the two spellings of the augmentation draw identical shifts from
+    identical keys."""
+    return jax.random.randint(key, (n, 2), 0, 2 * pad + 1)
 
 
 def random_shift(frames: jax.Array, key: jax.Array, pad: int = 4) -> jax.Array:
@@ -36,7 +46,7 @@ def random_shift(frames: jax.Array, key: jax.Array, pad: int = 4) -> jax.Array:
     padded = jnp.pad(
         flat, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="edge"
     )
-    offsets = jax.random.randint(key, (flat.shape[0], 2), 0, 2 * pad + 1)
+    offsets = shift_offsets(key, flat.shape[0], pad)
 
     def crop(img, off):
         return jax.lax.dynamic_slice(img, (off[0], off[1], 0), (h, w, c))

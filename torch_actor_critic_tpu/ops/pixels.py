@@ -1,0 +1,304 @@
+"""Fused replay-sample -> decode -> augment -> cast pixel pipeline.
+
+The TPU bench (BENCH_r03-r05) pins the visual workload at ~0.02 MFU
+while the same chip sustains 0.70 on synthetic bf16 matmuls — and the
+bench's own large-batch bf16 visual probe reaches 0.18, so the headroom
+is real. Part of the gap is the pixel hot path: every gradient step
+gathers a uint8 frame batch from the HBM ring (``buffer/replay.py``),
+round-trips it through pad/crop augmentation (``ops/augment.py``) and
+then materializes it as **float32** inside the CNN trunk
+(``models/visual.py`` decodes ``frame.astype(float32) / 255``) — a
+4x-width HBM write/read per forward, repeated across the four conv
+towers of a SAC step.
+
+This module fuses the whole chain into one kernel so the sampled frame
+batch reaches the MXU in its compute dtype without ever existing as
+f32 in HBM:
+
+    replay-gather (+ frame stacking) -> uint8 decode -> DrQ random
+    shift -> normalize -> cast to compute dtype
+
+Three implementations of the same math, one contract — exactly the
+``ops/attention.py`` scheme:
+
+- :func:`gather_frames_reference` — pure jnp (gather + clipped-index
+  shift + cast). Ground truth for tests; the training-path default on
+  non-TPU backends.
+- :func:`_gather_frames_pallas` — a Pallas TPU kernel: grid
+  ``(batch, stack)``, the replay row selected per program via
+  scalar-prefetch index maps (the ring never streams — one frame block
+  of VMEM per program), the DrQ shift expressed as two one-hot
+  **matmul-gathers** (MXU-friendly selection; exact for uint8 values,
+  which are integers <= 255 and therefore exactly representable in
+  f32 *and* bf16), decode/normalize fused into the epilogue, output
+  written directly in the compute dtype. ADOPTION GATE: validated in
+  interpret mode (CPU CI); Mosaic may reject the uint8 VMEM blocks or
+  the in-kernel transpose on some generations — the ``impl`` dispatch
+  keeps the XLA path one flag away until a chip artifact in
+  ``runs/tpu/`` shows the kernel lowering and winning.
+- :func:`fused_frame_gather` — the dispatch: ``'pallas'`` on a
+  TPU-default backend, ``'xla'`` otherwise; ``interpret=True`` runs
+  the kernel in the Pallas interpreter for CPU tests. Tracing the
+  Pallas path on a non-TPU process raises at trace time (the
+  ``flash_attention`` footgun guard).
+
+Bit contract (pinned by tests/test_pixels.py): all three paths agree
+BITWISE for every (out_dtype, normalize, augment, frame_stack)
+combination, and the f32/no-augment output equals what the legacy path
+computes inside the model (gather -> ``astype(float32)`` ->
+``/ 255``), so switching ``pixel_pipeline="fused"`` at f32 changes
+nothing but where the decode runs. Decode order is
+``uint8 -> out_dtype -> (/255)``: integers <= 255 are exact in bf16,
+so no f32 intermediate is needed for exact decoding, and the jaxpr of
+the fused sample provably contains no f32 frame-batch tensor.
+
+Frame stacking (``frame_stack > 1``) gathers the ``S`` ring rows
+``idx - S + 1 .. idx`` (modular) and concatenates them on channels —
+the gather-in-kernel formulation of a host-side frame stacker. NOTE:
+the ring is a transition buffer, so stacked rows are consecutive
+*pushes*; callers own the episode-boundary semantics (the built-in
+envs bake temporal context into channels instead — see
+``envs/pixel_pendulum.py`` — which is why training wires
+``frame_stack=1`` today).
+"""
+
+from __future__ import annotations
+
+import functools
+import typing as t
+
+import jax
+import jax.numpy as jnp
+
+from torch_actor_critic_tpu.ops.augment import shift_offsets
+
+__all__ = [
+    "fused_frame_gather",
+    "gather_frames_reference",
+    "stack_rows",
+]
+
+
+def stack_rows(
+    idx: jax.Array, frame_stack: int, capacity: int
+) -> jax.Array:
+    """Ring rows backing a stacked gather: ``(B, S)`` int32, oldest
+    first, newest (``idx`` itself) last, modular on the ring."""
+    if frame_stack < 1:
+        raise ValueError(f"frame_stack must be >= 1, got {frame_stack}")
+    offsets = jnp.arange(frame_stack - 1, -1, -1, dtype=idx.dtype)
+    return (idx[:, None] - offsets[None, :]) % capacity
+
+
+def _decode(x: jax.Array, normalize: bool, out_dtype) -> jax.Array:
+    """uint8 -> compute dtype, optionally rescaled to [0, 1].
+
+    The cast precedes the divide ON PURPOSE: integers <= 255 are exact
+    in every supported compute dtype (bf16 carries 8 significand bits),
+    so decoding never needs an f32 intermediate — the property the
+    no-f32-materialization test pins on the jaxpr.
+    """
+    x = x.astype(out_dtype)
+    if normalize:
+        x = x / jnp.asarray(255.0, out_dtype)
+    return x
+
+
+def _clipped_axis_indices(
+    offsets: jax.Array, length: int, pad: int
+) -> jax.Array:
+    """Per-example source indices of a DrQ shift along one axis:
+    ``clip(i + off - pad, 0, length-1)`` — identical to edge-padding by
+    ``pad`` and cropping at ``off`` (``ops/augment.random_shift``),
+    without materializing the padded frame."""
+    return jnp.clip(
+        jnp.arange(length)[None, :] + offsets[:, None] - pad, 0, length - 1
+    )
+
+
+def gather_frames_reference(
+    ring: jax.Array,
+    idx: jax.Array,
+    offsets: jax.Array | None = None,
+    pad: int = 4,
+    normalize: bool = False,
+    out_dtype=jnp.float32,
+    frame_stack: int = 1,
+) -> jax.Array:
+    """Pure-jnp reference of the fused pipeline (ground truth).
+
+    ``ring`` is the uint8 replay frame ring ``(capacity, H, W, C)``;
+    ``idx`` the sampled rows ``(B,)``; ``offsets`` the per-example DrQ
+    shift draws ``(B, 2)`` in ``[0, 2*pad]`` (None = no augmentation).
+    Returns ``(B, H, W, frame_stack*C)`` in ``out_dtype``.
+    """
+    b = idx.shape[0]
+    capacity, h, w, c = ring.shape
+    rows = stack_rows(idx, frame_stack, capacity)
+    frames = jnp.take(ring, rows.reshape(-1), axis=0).reshape(
+        b, frame_stack, h, w, c
+    )
+    if offsets is not None:
+        ys = _clipped_axis_indices(offsets[:, 0], h, pad)
+        xs = _clipped_axis_indices(offsets[:, 1], w, pad)
+        # Shift while still uint8: index moves, no arithmetic.
+        frames = jnp.take_along_axis(
+            frames, ys[:, None, :, None, None], axis=2
+        )
+        frames = jnp.take_along_axis(
+            frames, xs[:, None, None, :, None], axis=3
+        )
+    out = _decode(frames, normalize, out_dtype)
+    # (B, S, H, W, C) -> (B, H, W, S*C): temporal context on channels,
+    # newest frame in the last C channels.
+    return out.transpose(0, 2, 3, 1, 4).reshape(b, h, w, frame_stack * c)
+
+
+# --------------------------------------------------------------------------
+# Pallas TPU kernel
+# --------------------------------------------------------------------------
+
+
+def _pixel_kernel(
+    rows_ref, offs_ref, ring_ref, o_ref, *,
+    pad: int, normalize: bool, augment: bool, out_dtype,
+):
+    """One ``(example, stack-slot)`` program.
+
+    The replay row was already selected by the scalar-prefetch index
+    map (``rows_ref[i, s]`` steers the ring BlockSpec), so the body
+    only sees one ``(H, W, C)`` uint8 frame in VMEM. The DrQ shift is
+    two one-hot matmul-gathers — selection expressed as MXU work, the
+    layout TPUs execute well — computed in f32 where every uint8 value
+    is exact, then decoded straight into the output dtype.
+    """
+    from jax.experimental import pallas as pl  # deferred: TPU-only path
+
+    i = pl.program_id(0)
+    frame = ring_ref[0]  # (H, W, C) uint8
+    h, w, c = frame.shape
+    if not augment:
+        o_ref[0] = _decode(frame, normalize, out_dtype)
+        return
+    oy = offs_ref[i, 0]
+    ox = offs_ref[i, 1]
+    f = frame.astype(jnp.float32)
+    sy = jnp.clip(
+        jax.lax.broadcasted_iota(jnp.int32, (h,), 0) + oy - pad, 0, h - 1
+    )
+    onehot_y = (
+        jax.lax.broadcasted_iota(jnp.int32, (h, h), 1) == sy[:, None]
+    ).astype(jnp.float32)
+    g = jax.lax.dot_general(
+        onehot_y, f.reshape(h, w * c), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).reshape(h, w, c)
+    sx = jnp.clip(
+        jax.lax.broadcasted_iota(jnp.int32, (w,), 0) + ox - pad, 0, w - 1
+    )
+    # onehot_x[w, x] = (w == sx[x]); contracting g's W axis against it
+    # yields out[y, c, x] — one transpose back to (y, x, c).
+    onehot_x = (
+        jax.lax.broadcasted_iota(jnp.int32, (w, w), 0) == sx[None, :]
+    ).astype(jnp.float32)
+    out = jax.lax.dot_general(
+        g, onehot_x, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).transpose(0, 2, 1)
+    # The matmul-gather is exact selection (one unit term per output),
+    # so `out` holds the original integer values: the f32->out_dtype
+    # cast is exact and the decode contract matches the reference path
+    # bit for bit.
+    out = out.astype(out_dtype)
+    if normalize:
+        out = out / jnp.asarray(255.0, out_dtype)
+    o_ref[0] = out
+
+
+def _gather_frames_pallas(
+    ring: jax.Array,
+    idx: jax.Array,
+    offsets: jax.Array | None,
+    pad: int,
+    normalize: bool,
+    out_dtype,
+    frame_stack: int,
+    interpret: bool,
+) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not interpret and jax.default_backend() != "tpu":
+        # Same trace-time guard as flash_attention: without it a
+        # compiled Pallas call on a CPU/GPU process dies much later in
+        # lowering with a cryptic Mosaic error.
+        raise RuntimeError(
+            "fused_frame_gather compiles Pallas TPU kernels but this "
+            f"process's default backend is {jax.default_backend()!r}; "
+            "use impl='xla' (the pure-jnp reference path) or pass "
+            "interpret=True for CPU testing."
+        )
+    b = idx.shape[0]
+    capacity, h, w, c = ring.shape
+    rows = stack_rows(idx.astype(jnp.int32), frame_stack, capacity)
+    augment = offsets is not None
+    if offsets is None:
+        # Scalar-prefetch operands are positional; feed a zero block
+        # the no-augment kernel never reads.
+        offsets = jnp.zeros((b, 2), jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, frame_stack),
+        in_specs=[
+            pl.BlockSpec(
+                (1, h, w, c), lambda i, s, rows, offs: (rows[i, s], 0, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, h, w, c), lambda i, s, rows, offs: (i, 0, 0, s)
+        ),
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _pixel_kernel, pad=pad, normalize=normalize, augment=augment,
+            out_dtype=out_dtype,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, w, frame_stack * c), out_dtype),
+        interpret=interpret,
+    )(rows, offsets.astype(jnp.int32), ring)
+
+
+def fused_frame_gather(
+    ring: jax.Array,
+    idx: jax.Array,
+    offsets: jax.Array | None = None,
+    pad: int = 4,
+    normalize: bool = False,
+    out_dtype=jnp.float32,
+    frame_stack: int = 1,
+    impl: str = "auto",
+    interpret: bool = False,
+) -> jax.Array:
+    """Dispatch: the Pallas kernel on a TPU-default backend, the jnp
+    reference elsewhere (``'auto'`` decides at trace time, like
+    ``ops/attention.attention``). All paths are bitwise-equal — the
+    choice is a performance decision, never a numeric one."""
+    if ring.dtype != jnp.uint8:
+        raise ValueError(
+            f"fused_frame_gather decodes uint8 replay frames, got "
+            f"{ring.dtype}; the HBM ring stores frames as uint8 by "
+            "design (buffer/replay.py)"
+        )
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        return _gather_frames_pallas(
+            ring, idx, offsets, pad, normalize, out_dtype, frame_stack,
+            interpret,
+        )
+    if impl != "xla":
+        raise ValueError(f"unknown impl {impl!r} (auto|pallas|xla)")
+    return gather_frames_reference(
+        ring, idx, offsets, pad, normalize, out_dtype, frame_stack
+    )

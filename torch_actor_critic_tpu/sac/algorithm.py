@@ -30,8 +30,17 @@ import jax.numpy as jnp
 import optax
 from flax import linen as nn
 
-from torch_actor_critic_tpu.buffer.replay import push, sample
-from torch_actor_critic_tpu.core.types import Batch, BufferState, TrainState
+from torch_actor_critic_tpu.buffer.replay import (
+    push,
+    sample,
+    sample_fused_visual,
+)
+from torch_actor_critic_tpu.core.types import (
+    Batch,
+    BufferState,
+    MultiObservation,
+    TrainState,
+)
 from torch_actor_critic_tpu.diagnostics import ingraph as diag
 from torch_actor_critic_tpu.ops.polyak import polyak_update
 from torch_actor_critic_tpu.ops.augment import augment_batch
@@ -197,7 +206,7 @@ class SAC:
         """
         cfg = self.config
         tier = cfg.diagnostics
-        if cfg.frame_augment != "none":
+        if cfg.frame_augment != "none" and cfg.pixel_pipeline != "fused":
             rng, key_q, key_pi, key_aug = jax.random.split(state.rng, 4)
             batch = augment_batch(
                 batch, key_aug, cfg.frame_augment, cfg.augment_pad
@@ -205,7 +214,10 @@ class SAC:
         else:
             # Parity path keeps the historical 3-way split: 'none' must
             # reproduce pre-augmentation streams bit-for-bit (resumed
-            # checkpoints, recorded evidence runs).
+            # checkpoints, recorded evidence runs). The fused pixel
+            # pipeline lands here too: its frames arrive already
+            # shifted (offsets drawn at sample time), so the update
+            # consumes no augmentation key.
             rng, key_q, key_pi = jax.random.split(state.rng, 3)
         # Per-run hyperparameters (PBT): when the state carries a
         # hyperparams dict its traced values replace the config scalars
@@ -424,14 +436,34 @@ def run_update_burst(
     Metric reduction over the scan axis is suffix-keyed
     (:func:`~torch_actor_critic_tpu.diagnostics.ingraph.reduce_burst_metrics`);
     none of the base metric keys match a special suffix, so without
-    diagnostics this is exactly the historical per-burst mean."""
+    diagnostics this is exactly the historical per-burst mean.
+
+    ``config.pixel_pipeline="fused"`` swaps the plain :func:`sample`
+    for :func:`~torch_actor_critic_tpu.buffer.replay.sample_fused_visual`
+    on visual buffers: the frame leaves decode/augment/cast inside the
+    fused gather and reach the learner already in the compute dtype —
+    the one integration point, so the host Trainer, the dp/GSPMD
+    burst, TD3 and the fused on-device + population loops all ride it.
+    """
     buffer_state = push(buffer_state, chunk)
+    fused_visual = config.pixel_pipeline == "fused" and isinstance(
+        buffer_state.data.states, MultiObservation
+    )
 
     def body(carry, _):
         st, buf = carry
         rng, sample_key = jax.random.split(st.rng)
         st = st.replace(rng=rng)
-        batch = sample(buf, sample_key, config.batch_size)
+        if fused_visual:
+            batch = sample_fused_visual(
+                buf, sample_key, config.batch_size,
+                out_dtype=config.model_dtype,
+                augment=config.frame_augment,
+                pad=config.augment_pad,
+                normalize=config.normalize_pixels,
+            )
+        else:
+            batch = sample(buf, sample_key, config.batch_size)
         st, metrics = update_fn(st, batch, axis_name)
         return (st, buf), metrics
 

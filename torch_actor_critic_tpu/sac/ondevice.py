@@ -817,7 +817,7 @@ def _abstract_args(*trees):
 
 def _note_epoch_cost(
     loop, sig, abstract, cost_state, metrics, dt, telemetry, e,
-    devices: int = 1,
+    devices: int = 1, compute_dtype: str | None = None,
 ):
     """Fused-loop per-epoch cost attribution (telemetry on only):
     register the epoch program's XLA cost analysis once, then add
@@ -847,7 +847,10 @@ def _note_epoch_cost(
         return
     if cost_state["peaks"] is None:
         cost_state["peaks"] = Peaks.detect()
-    rl = roofline(cost, dt, calls=1, peaks=cost_state["peaks"])
+    rl = roofline(
+        cost, dt, calls=1, peaks=cost_state["peaks"],
+        compute_dtype=compute_dtype,
+    )
     metrics["cost/epoch_gflops"] = cost["flops"] / 1e9
     metrics["cost/epoch_achieved_gflops_s"] = (
         rl.get("achieved_flops_per_sec", 0.0) / 1e9
@@ -861,6 +864,7 @@ def _note_epoch_cost(
     telemetry.event(
         "cost", epoch=int(e), programs={loop.epoch_cost_name: rl},
         device_kind=cost_state["peaks"].device_kind,
+        compute_dtype=compute_dtype,
     )
 
 
@@ -967,6 +971,7 @@ def train_on_device(
             _note_epoch_cost(
                 loop, sig, cost_abstract, cost_state, metrics, dt,
                 telemetry, e, devices=loop.n_dp,
+                compute_dtype=config.compute_dtype,
             )
         if tracker is not None and is_coordinator():
             tracker.log_metrics(metrics, e)
@@ -1165,6 +1170,7 @@ def train_population_on_device(
                 devices=(
                     pop_mesh.shape["dp"] if pop_mesh is not None else 1
                 ),
+                compute_dtype=config.compute_dtype,
             )
         if pbt_event is not None:
             ev = jax.device_get(pbt_event)

@@ -99,6 +99,16 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
             f"frame_augment={config.frame_augment!r} requires a visual "
             f"(frame) observation; got obs spec {env.obs_spec}"
         )
+    if config.pixel_pipeline == "fused" and not isinstance(
+        env.obs_spec, MultiObservation
+    ):
+        # Same fail-at-construction policy: a fused pixel pipeline
+        # silently no-opping on flat/sequence observations would let a
+        # user believe the f32-free frame path was active.
+        raise ValueError(
+            "pixel_pipeline='fused' requires a visual (frame) "
+            f"observation; got obs spec {env.obs_spec}"
+        )
     if config.algorithm == "td3":
         # TD3 (extension): deterministic tanh policy over the flat MLP
         # or visual stack (same twin critics as SAC). The sequence
@@ -700,7 +710,10 @@ class Trainer:
         burst_s = (
             rec.timer.sums[_PH_BURST] + rec.timer.sums[_PH_DRAIN]
         )
-        rl = roofline(cost, burst_s, calls=n_bursts, peaks=self._peaks)
+        rl = roofline(
+            cost, burst_s, calls=n_bursts, peaks=self._peaks,
+            compute_dtype=self.config.compute_dtype,
+        )
         last_metrics["cost/update_burst_gflops"] = cost["flops"] / 1e9
         last_metrics["cost/update_burst_achieved_gflops_s"] = (
             rl.get("achieved_flops_per_sec", 0.0) / 1e9
@@ -718,6 +731,7 @@ class Trainer:
         rec.event(
             "cost", epoch=int(epoch), programs={name: rl},
             device_kind=self._peaks.device_kind,
+            compute_dtype=self.config.compute_dtype,
         )
 
     # --------------------------------------------------------- resilience

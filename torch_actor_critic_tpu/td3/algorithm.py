@@ -152,14 +152,15 @@ class TD3:
         # Per-run hyperparameters (PBT) — see the matching note in
         # sac/algorithm.py.
         hp = state.hyperparams if state.hyperparams is not None else {}
-        if cfg.frame_augment != "none":
+        if cfg.frame_augment != "none" and cfg.pixel_pipeline != "fused":
             rng, key_q, key_aug = jax.random.split(state.rng, 3)
             batch = augment_batch(
                 batch, key_aug, cfg.frame_augment, cfg.augment_pad
             )
         else:
             # Parity path: keep the historical 2-way split (see the
-            # matching note in sac/algorithm.py).
+            # matching note in sac/algorithm.py; fused-pipeline frames
+            # arrive pre-shifted, so no augmentation key here either).
             rng, key_q = jax.random.split(state.rng)
 
         # --- critic step (every step) ---

@@ -151,6 +151,7 @@ def roofline(
     duration_s: float,
     calls: int = 1,
     peaks: Peaks | None = None,
+    compute_dtype: str | None = None,
 ) -> dict:
     """One program's live roofline position.
 
@@ -162,6 +163,12 @@ def roofline(
     ``compute``/``memory`` bound classification: a program whose
     intensity sits left of ``peak_flops / peak_bw`` cannot reach peak
     FLOP/s no matter how well it schedules; its ceiling is bandwidth.
+
+    ``compute_dtype`` stamps the program's matmul precision policy
+    (``SACConfig.compute_dtype``) onto the record: an MFU read against
+    the bf16 peak means something different for an f32 program (which
+    cannot reach it on MXU hardware), so ``cost`` events carry the
+    dtype explicitly rather than leaving readers to guess.
     """
     def sig(x, digits=4):
         # Significant-digit rounding: fixed-decimal rounding truncates
@@ -177,6 +184,8 @@ def roofline(
         "calls": int(calls),
         "duration_s": round(float(duration_s), 6),
     }
+    if compute_dtype is not None:
+        out["compute_dtype"] = str(compute_dtype)
     if duration_s > 0 and calls > 0:
         out["achieved_flops_per_sec"] = flops * calls / duration_s
         out["achieved_bytes_per_sec"] = bytes_ * calls / duration_s

@@ -115,6 +115,17 @@ def parse_arguments(argv=None) -> argparse.Namespace:
         "signal triggers an emergency checkpoint and exit with the "
         "requeue code %d; see docs/RESILIENCE.md)" % REQUEUE_EXIT_CODE,
     )
+    parser.add_argument(
+        "--precision",
+        choices=("f32", "bf16"),
+        default=None,
+        help="Mixed-precision training policy (alias of --compute-dtype): "
+        "bf16 runs the CNN trunk + MLP matmuls in bfloat16 with f32 "
+        "master weights and f32 loss/target/optimizer math — "
+        "loss-scale-free on TPU; f32 is the bitwise-pinned parity "
+        "default (docs/SCALING.md 'Mixed precision & the pixel "
+        "pipeline')",
+    )
     # Every SACConfig field becomes a flag (--batch-size, --learn-alpha, ...).
     for f in dataclasses.fields(SACConfig):
         flag = "--" + f.name.replace("_", "-")
@@ -140,6 +151,16 @@ def config_from_args(args: argparse.Namespace) -> SACConfig:
         v = getattr(args, f.name, None)
         if v is not None:
             overrides[f.name] = v
+    if getattr(args, "precision", None) is not None:
+        alias = {"f32": "float32", "bf16": "bfloat16"}
+        want = alias[args.precision]
+        have = overrides.get("compute_dtype")
+        if have is not None and alias.get(have, have) != want:
+            raise ValueError(
+                f"--precision {args.precision} conflicts with "
+                f"--compute-dtype {have}; pass one"
+            )
+        overrides["compute_dtype"] = want
     return SACConfig(**overrides)
 
 

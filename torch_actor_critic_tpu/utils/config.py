@@ -78,6 +78,20 @@ class SACConfig:
     frame_augment: str = "none"
     augment_pad: int = 4
     normalize_pixels: bool = False
+    # Pixel hot path (ops/pixels.py, docs/SCALING.md "Mixed precision
+    # & the pixel pipeline"). "reference" (parity default): sample
+    # gathers uint8 frames and the CNN trunk decodes them to float32
+    # in-graph — the historical path, bit-pinned. "fused": replay
+    # gather + uint8 decode + DrQ shift + cast-to-compute-dtype run as
+    # ONE fused gather (a Pallas kernel on TPU, the bitwise-equal jnp
+    # reference elsewhere), so the sampled frame batch reaches the conv
+    # towers in the compute dtype without ever materializing as f32 in
+    # HBM. At compute_dtype=float32 with frame_augment="none" the two
+    # pipelines are bitwise-identical per update (pinned by
+    # tests/test_pixels.py); with augmentation the fused path draws its
+    # shift offsets at sample time, so the PRNG streams differ by
+    # construction. Visual observations only (build_models enforces).
+    pixel_pipeline: str = "reference"
 
     # Sequence-policy extension: history_len > 1 wraps the env in a
     # sliding observation window (envs/wrappers.py HistoryEnv) and
@@ -139,10 +153,16 @@ class SACConfig:
     # usable option).
     normalize_observations: bool = False
 
-    # Network compute dtype: "float32" (parity default) or "bfloat16"
-    # (the MXU's native input width — matmuls/convs run bf16 while
-    # params, optimizer state, targets and all loss/distribution math
-    # stay float32, so checkpoints are precision-independent). The
+    # Network compute dtype — the mixed-precision training policy
+    # (docs/SCALING.md "Mixed precision & the pixel pipeline"):
+    # "float32" (parity default) or "bfloat16" (the MXU's native input
+    # width — CNN-trunk convs and MLP matmuls run bf16 while params
+    # (master weights), optimizer state, Bellman targets and all
+    # loss/distribution math stay float32, so checkpoints are
+    # precision-independent and no loss scaling is needed: bf16 shares
+    # f32's 8-bit exponent, so there is no fp16-style underflow cliff
+    # to scale away). The short aliases "f32"/"bf16" (the
+    # `--precision` CLI spelling) normalize to the long names. The
     # torch reference has no mixed-precision path at all.
     compute_dtype: str = "float32"
 
@@ -270,10 +290,20 @@ class SACConfig:
                 "filters/kernel_sizes/strides must have equal length, got "
                 f"{len(self.filters)}/{len(self.kernel_sizes)}/{len(self.strides)}"
             )
+        # `--precision {f32,bf16}` aliases normalize to the long names
+        # so stored configs/checkpoints carry one canonical spelling.
+        self.compute_dtype = {"f32": "float32", "bf16": "bfloat16"}.get(
+            self.compute_dtype, self.compute_dtype
+        )
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(
-                f"compute_dtype must be 'float32' or 'bfloat16', got "
-                f"{self.compute_dtype!r}"
+                f"compute_dtype must be 'float32'/'f32' or "
+                f"'bfloat16'/'bf16', got {self.compute_dtype!r}"
+            )
+        if self.pixel_pipeline not in ("reference", "fused"):
+            raise ValueError(
+                f"pixel_pipeline must be 'reference' or 'fused', got "
+                f"{self.pixel_pipeline!r}"
             )
         if self.algorithm not in ("sac", "td3"):
             raise ValueError(
