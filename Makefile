@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke decouple-smoke visual-smoke
+.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke decouple-smoke visual-smoke scenario-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -134,6 +134,14 @@ decouple-smoke:
 # pixel pipeline").
 visual-smoke:
 	JAX_PLATFORMS=cpu python scripts/visual_smoke.py
+
+# Scenario-workloads smoke (CPU, real CLI): every scenarios/ pillar —
+# multi-agent (per-agent reward curves), procedural (fresh level per
+# episode, finite returns), multi-task (schema-valid per-task metrics
+# from striped replay) — plus a bitwise population resume over the
+# multi-task scenario (docs/SCENARIOS.md).
+scenario-smoke:
+	JAX_PLATFORMS=cpu python scripts/scenario_smoke.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -854,6 +854,36 @@ def bench_on_device(budget_s=300.0):
     return out
 
 
+def bench_scenarios(budget_s=300.0):
+    """Fused-loop throughput per scenarios/ family (multi-agent,
+    procedural, multi-task) against the pendulum baseline measured in
+    the SAME process/config — the scenario-diversity counterpart of
+    `on_device`: how much env-steps/s each workload family costs
+    relative to the classic single-agent physics. Best-effort."""
+    out = {}
+    t_start = time.time()
+    try:
+        from torch_actor_critic_tpu.sac.ondevice import benchmark_on_device
+    except ImportError:
+        return {"error": "benchmark_on_device not available"}
+    for env_name in ("pendulum", "multiagent", "procedural", "multitask"):
+        if time.time() - t_start > budget_s:
+            out[env_name] = {"error": "budget exhausted"}
+            continue
+        try:
+            out[env_name] = benchmark_on_device(env_name, n_envs=16)
+        except Exception as e:  # noqa: BLE001
+            out[env_name] = {"error": repr(e)}
+    base = out.get("pendulum", {}).get("env_steps_per_sec")
+    if base:
+        for env_name, row in out.items():
+            if isinstance(row, dict) and row.get("env_steps_per_sec"):
+                row["vs_pendulum"] = round(
+                    row["env_steps_per_sec"] / base, 3
+                )
+    return out
+
+
 def bench_attention(budget_s=180.0, t=2048, block_sweep=False):
     """Flash-attention kernel throughput (the long-context extension's
     hot op): causal fwd and fwd+bwd at a long-context shape, reported
@@ -2152,6 +2182,11 @@ _STAGES = {
         "diagnostics_overhead": bench_diagnostics_overhead()
     },
     "on_device": lambda: {"on_device": bench_on_device()},
+    # scenarios/ families (multi-agent / procedural / multi-task)
+    # vs the pendulum baseline — ROADMAP item 3's perf evidence.
+    "scenarios": lambda: {
+        "scenarios": bench_scenarios(budget_s=stage_budget(300.0))
+    },
     # Two sequence lengths: the O(block)-memory kernel's scaling story —
     # 4x the length = 16x the FLOPs at flat VMEM residency.
     "attention": lambda: {
@@ -2420,7 +2455,8 @@ def main():
             # each; its timeout covers both plus init + compiles.
             ("sweep", 900), ("sharding", 540), ("unroll", 420),
             ("td3", 420),
-            ("population", 720), ("on_device", 540), ("attention", 900),
+            ("population", 720), ("on_device", 540), ("scenarios", 420),
+            ("attention", 900),
         ):
             res = run_stage_subprocess(
                 stage, timeout_s, diagnostics, platform=info.get("platform")

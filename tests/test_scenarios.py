@@ -1,0 +1,606 @@
+"""scenarios/: multi-agent, procedural, and multi-task workloads.
+
+Covers the PR's acceptance contract:
+
+- the multi-agent scenario trains under the fused on-device loop with
+  per-agent metrics;
+- the procedural family provably varies its level per episode off the
+  env PRNG stream (two episodes, same policy, different level params);
+- multi-task training stripes replay per task and serves each trained
+  task as its own slot on the existing multi-slot registry;
+- existing single-agent scenario paths stay bitwise-unchanged (loop
+  routing, metric-key set, and an output-bitwise pin of the scenario
+  loop against the base loop on a classic env);
+- `get_on_device_env` unknown-name errors list the registered
+  scenario names;
+- `history_env` composes over the scenario classes (level params /
+  agent-task structure preserved).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_actor_critic_tpu.buffer.striped import (
+    StripedBufferState,
+    init_striped_replay_buffer,
+    push_striped,
+    sample_striped,
+)
+from torch_actor_critic_tpu.core.types import Batch
+from torch_actor_critic_tpu.diagnostics.ingraph import split_scenario_metrics
+from torch_actor_critic_tpu.envs.ondevice import (
+    ON_DEVICE_ENVS,
+    get_on_device_env,
+    history_env,
+    known_on_device_envs,
+)
+from torch_actor_critic_tpu.sac.ondevice import (
+    OnDeviceLoop,
+    PopulationOnDeviceLoop,
+    _wrap_and_build,
+    loop_class_for,
+)
+from torch_actor_critic_tpu.scenarios import (
+    HurdleRunnerJax,
+    PendulumMultiTaskJax,
+    get_scenario,
+    multi_agent_pendulum,
+    register_scenario,
+    scenario_names,
+)
+from torch_actor_critic_tpu.scenarios.loop import ScenarioOnDeviceLoop
+from torch_actor_critic_tpu.scenarios.serving import (
+    TaskSlotPolicy,
+    register_scenario_slots,
+    scenario_slot_names,
+)
+from torch_actor_critic_tpu.utils.config import SACConfig
+
+
+def small_config(**kw):
+    base = dict(hidden_sizes=(16, 16), batch_size=15, buffer_size=3000)
+    base.update(kw)
+    return SACConfig(**base)
+
+
+def short_env(env_cls, steps=10):
+    """Subclass an on-device env with a short episode so epoch tests
+    finish episodes; classmethods read limits through ``cls``."""
+    cls = type(f"Short{env_cls.__name__}", (env_cls,), {})
+    cls.max_episode_steps = steps
+    return cls
+
+
+def run_loop(loop_cls, sac, env_cls, n_envs=4, seed=0, capacity=3000):
+    """One fused train epoch (no separate warmup program — the burst
+    pushes its chunk before sampling, so the ring is never empty).
+    Keeps the per-test compile count at one epoch program."""
+    loop = loop_cls(sac, env_cls, n_envs=n_envs)
+    ts, buf, es, key = loop.init(jax.random.key(seed), buffer_capacity=capacity)
+    ts, buf, es, key, m = loop.epoch(ts, buf, es, key, steps=20, update_every=10)
+    return loop, ts, buf, m
+
+
+def leaf_bytes(tree):
+    out = []
+    for x in jax.tree_util.tree_leaves(tree):
+        if jax.dtypes.issubdtype(
+            getattr(x, "dtype", jnp.float32), jax.dtypes.prng_key
+        ):
+            x = jax.random.key_data(x)
+        out.append(np.asarray(x).tobytes())
+    return out
+
+
+# ---------------------------------------------------------------- registry
+
+
+class TestRegistry:
+    def test_builtin_scenarios_registered(self):
+        assert {
+            "multi-pendulum-2", "multi-pendulum-4", "hurdle-runner",
+            "pendulum-multitask",
+        } <= set(scenario_names())
+
+    def test_get_scenario_unknown_lists_names(self):
+        with pytest.raises(ValueError) as e:
+            get_scenario("definitely-not-a-scenario")
+        msg = str(e.value)
+        for name in scenario_names():
+            assert name in msg
+        assert "Pendulum-v1" in msg  # the full on-device list rides along
+
+    def test_get_on_device_env_resolves_scenarios(self):
+        assert get_on_device_env("hurdle-runner") is HurdleRunnerJax
+        assert get_on_device_env("pendulum-multitask") is PendulumMultiTaskJax
+        assert get_on_device_env("no-such-env") is None
+
+    def test_known_envs_superset(self):
+        known = known_on_device_envs()
+        assert set(ON_DEVICE_ENVS) <= set(known)
+        assert set(scenario_names()) <= set(known)
+
+    def test_register_collision_raises(self):
+        with pytest.raises(ValueError, match="already registered"):
+            register_scenario("hurdle-runner", HurdleRunnerJax)
+        with pytest.raises(ValueError, match="already registered"):
+            register_scenario("Pendulum-v1", HurdleRunnerJax)
+
+    def test_train_driver_unknown_env_lists_scenarios(self):
+        from torch_actor_critic_tpu.sac.ondevice import train_on_device
+
+        with pytest.raises(ValueError) as e:
+            train_on_device("no-such-env", small_config(on_device=True))
+        assert "hurdle-runner" in str(e.value)
+        assert "pendulum-multitask" in str(e.value)
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="ma_critic"):
+            SACConfig(ma_critic="nope")
+        with pytest.raises(ValueError, match="task_embed_dim"):
+            SACConfig(task_embed_dim=-1)
+
+
+# ------------------------------------------------- single-agent pin
+
+
+class TestSingleAgentPin:
+    def test_loop_routing(self):
+        for cls in set(ON_DEVICE_ENVS.values()):
+            assert loop_class_for(cls) is OnDeviceLoop
+        # Procedural env has no agent/task structure: base loop.
+        assert loop_class_for(HurdleRunnerJax) is OnDeviceLoop
+        assert loop_class_for(PendulumMultiTaskJax) is ScenarioOnDeviceLoop
+        assert loop_class_for(multi_agent_pendulum(2)) is ScenarioOnDeviceLoop
+
+    def test_scenario_loop_bitwise_on_classic_env(self):
+        """The scenario machinery must be a no-op for classic envs:
+        same metric keys, bitwise-equal state and metrics."""
+        cfg = small_config(batch_size=16, buffer_size=2000)
+        env_cls, sac = _wrap_and_build(ON_DEVICE_ENVS["Pendulum-v1"], cfg)
+        _, ts_a, _, m_a = run_loop(OnDeviceLoop, sac, env_cls, capacity=2000)
+        _, ts_b, _, m_b = run_loop(
+            ScenarioOnDeviceLoop, sac, env_cls, capacity=2000
+        )
+        assert sorted(m_a) == sorted(m_b) == [
+            "episodes", "loss_pi", "loss_q", "reward",
+        ]
+        for k in m_a:
+            assert np.array_equal(
+                np.asarray(m_a[k]), np.asarray(m_b[k]), equal_nan=True
+            ), k
+        assert leaf_bytes(ts_a) == leaf_bytes(ts_b)
+
+    def test_split_scenario_metrics_scalars_passthrough(self):
+        m = {"loss_q": jnp.float32(1.5), "reward": np.float32(-3.0)}
+        assert split_scenario_metrics(m) == {"loss_q": 1.5, "reward": -3.0}
+
+    def test_split_scenario_metrics_axes(self):
+        out = split_scenario_metrics({
+            "reward_per_agent": np.array([1.0, 2.0]),
+            "reward_per_task": np.array([3.0, 4.0, 5.0]),
+            "other_vec": np.array([6.0, 7.0]),
+        })
+        assert out == {
+            "reward_a0": 1.0, "reward_a1": 2.0,
+            "reward_t0": 3.0, "reward_t1": 4.0, "reward_t2": 5.0,
+            "other_vec_0": 6.0, "other_vec_1": 7.0,
+        }
+
+
+# ------------------------------------------------------------ multi-agent
+
+
+class TestMultiAgent:
+    def test_env_shapes_and_team_reward(self):
+        env = multi_agent_pendulum(3)
+        st = env.reset(jax.random.key(0))
+        assert st.obs.shape == (21,)
+        st2, out = env.step(st, jnp.zeros(3))
+        assert st2.obs.shape == (21,)
+        assert out.extras["return_per_agent"].shape == (3,)
+        # Team reward is the per-agent mean: recompute from the pre-step
+        # state (theta, theta_dot) and the zero action.
+        theta, theta_dot, _ = st.inner
+        angle = ((theta + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        expected = jnp.mean(-(angle**2 + 0.1 * theta_dot**2))
+        np.testing.assert_allclose(
+            float(out.reward), float(expected), rtol=1e-6
+        )
+
+    def test_actor_factorization(self):
+        """Zeroing agent 0's head params must not move agent 1's
+        action (decentralized per-agent heads, one joint sample)."""
+        cfg = small_config()
+        env_cls, sac = _wrap_and_build(multi_agent_pendulum(2), cfg)
+        ts = sac.init_state(jax.random.key(0), jnp.zeros(env_cls.obs_dim))
+        obs = jnp.ones((5, env_cls.obs_dim))
+        key = jax.random.key(7)
+        a_ref, _ = sac.actor_def.apply(ts.actor_params, obs, key)
+
+        def zero_agent0(x):
+            return x.at[0].set(0.0) if x.ndim >= 1 else x
+
+        params0 = jax.tree_util.tree_map(zero_agent0, ts.actor_params)
+        a_cut, _ = sac.actor_def.apply(params0, obs, key)
+        assert not np.allclose(a_cut[:, 0], a_ref[:, 0])  # agent 0 moved
+        np.testing.assert_array_equal(a_cut[:, 1], a_ref[:, 1])  # agent 1 pinned
+
+    def test_trains_with_per_agent_metrics(self):
+        cfg = small_config(batch_size=16)
+        env_cls, sac = _wrap_and_build(short_env(multi_agent_pendulum(2)), cfg)
+        loop_cls = loop_class_for(env_cls)
+        assert loop_cls is ScenarioOnDeviceLoop
+        _, _, _, m = run_loop(loop_cls, sac, env_cls)
+        assert np.isfinite(float(m["loss_q"]))
+        assert np.isfinite(float(m["loss_pi"]))
+        assert m["reward_per_agent"].shape == (2,)
+        assert np.all(np.isfinite(np.asarray(m["reward_per_agent"])))
+
+    def test_per_agent_critic_is_vdn_sum(self):
+        cfg = small_config(ma_critic="per_agent")
+        env_cls, sac = _wrap_and_build(multi_agent_pendulum(2), cfg)
+        from torch_actor_critic_tpu.models import MultiAgentDoubleCritic
+
+        assert isinstance(sac.critic_def, MultiAgentDoubleCritic)
+        ts = sac.init_state(jax.random.key(0), jnp.zeros(env_cls.obs_dim))
+        obs = jnp.ones((4, env_cls.obs_dim))
+        act = jnp.full((4, env_cls.act_dim), 0.3)
+        q = sac.critic_def.apply(ts.critic_params, obs, act)
+        assert q.shape == (cfg.num_qs, 4)
+        assert np.all(np.isfinite(np.asarray(q)))
+
+    def test_centralized_critic_is_plain_double_critic(self):
+        cfg = small_config()  # ma_critic defaults to centralized
+        _, sac = _wrap_and_build(multi_agent_pendulum(2), cfg)
+        from torch_actor_critic_tpu.models import DoubleCritic
+
+        assert type(sac.critic_def) is DoubleCritic
+
+
+# ------------------------------------------------------------- procedural
+
+
+class TestProcedural:
+    def test_reset_deterministic_per_key(self):
+        a = HurdleRunnerJax.level_params(HurdleRunnerJax.reset(jax.random.key(3)))
+        b = HurdleRunnerJax.level_params(HurdleRunnerJax.reset(jax.random.key(3)))
+        c = HurdleRunnerJax.level_params(HurdleRunnerJax.reset(jax.random.key(4)))
+        np.testing.assert_array_equal(a["hurdle_x"], b["hurdle_x"])
+        assert not np.allclose(a["hurdle_x"], c["hurdle_x"])
+
+    def test_level_varies_per_episode_same_policy(self):
+        """The acceptance pin: run two consecutive episodes under the
+        SAME (zero) policy; the auto-reset draws a fresh level off the
+        env PRNG stream, so every level parameter re-rolls."""
+        st = HurdleRunnerJax.reset(jax.random.key(11))
+        first = HurdleRunnerJax.level_params(st)
+        step = jax.jit(HurdleRunnerJax.step)
+        zero = jnp.zeros(HurdleRunnerJax.act_dim)
+        ended = False
+        for _ in range(HurdleRunnerJax.max_episode_steps):
+            st, out = step(st, zero)
+            ended = bool(out.ended)
+        assert ended
+        second = HurdleRunnerJax.level_params(st)
+        assert not np.allclose(first["hurdle_x"], second["hurdle_x"])
+        assert not np.allclose(first["hurdle_h"], second["hurdle_h"])
+        assert float(first["target_speed"]) != float(second["target_speed"])
+
+    def test_trains_under_base_loop(self):
+        cfg = small_config(batch_size=16)
+        env_cls, sac = _wrap_and_build(short_env(HurdleRunnerJax), cfg)
+        assert loop_class_for(env_cls) is OnDeviceLoop
+        _, _, _, m = run_loop(OnDeviceLoop, sac, env_cls)
+        assert sorted(m) == ["episodes", "loss_pi", "loss_q", "reward"]
+        assert np.isfinite(float(m["loss_q"]))
+        assert np.isfinite(float(m["reward"]))
+
+    def test_obs_reads_next_hurdles(self):
+        st = HurdleRunnerJax.reset(jax.random.key(5))
+        lp = HurdleRunnerJax.level_params(st)
+        d0 = float(st.obs[5]) * 20.0  # nearest hurdle, de-normalized
+        np.testing.assert_allclose(
+            d0, float(np.min(np.asarray(lp["hurdle_x"]))), rtol=1e-5
+        )
+
+
+# -------------------------------------------------------------- multi-task
+
+
+class TestMultiTask:
+    def test_task_persists_across_auto_reset(self):
+        env = short_env(PendulumMultiTaskJax, steps=5)
+        st = jax.vmap(env.reset)(jax.random.split(jax.random.key(0), 8))
+        tasks0 = np.asarray(st.inner[0])
+        step = jax.jit(jax.vmap(env.step))
+        for _ in range(12):  # crosses at least two auto-resets
+            st, out = step(st, jnp.zeros((8, 1)))
+        np.testing.assert_array_equal(np.asarray(st.inner[0]), tasks0)
+
+    def test_striped_push_routes_by_task(self):
+        n, t_dim = 12, 3
+        obs_spec = jax.ShapeDtypeStruct((PendulumMultiTaskJax.obs_dim,), jnp.float32)
+        buf = init_striped_replay_buffer(300, obs_spec, 1, t_dim)
+        assert buf.capacity == 100
+        tasks = np.array([0, 1, 2, 2, 1, 0, 0, 0, 2, 1, 1, 1])
+        obs = np.zeros((n, 6), np.float32)
+        obs[np.arange(n), 3 + tasks] = 1.0
+        obs[:, 0] = np.arange(n)  # row tag
+        chunk = Batch(
+            states=jnp.asarray(obs),
+            actions=jnp.zeros((n, 1)),
+            rewards=jnp.arange(n, dtype=jnp.float32),
+            next_states=jnp.asarray(obs),
+            done=jnp.zeros(n),
+        )
+        buf = jax.jit(push_striped)(buf, chunk)
+        np.testing.assert_array_equal(
+            np.asarray(buf.size), np.bincount(tasks, minlength=t_dim)
+        )
+        # Every stored row sits in its task's stripe, in push order.
+        for task in range(t_dim):
+            rows = np.asarray(buf.data.rewards[task][: buf.size[task]])
+            np.testing.assert_array_equal(rows, np.flatnonzero(tasks == task))
+
+    def test_striped_sample_is_task_balanced(self):
+        t_dim = 3
+        obs_spec = jax.ShapeDtypeStruct((6,), jnp.float32)
+        buf = init_striped_replay_buffer(300, obs_spec, 1, t_dim)
+        # Wildly imbalanced pushes: 60 of task 0, 3 of task 1, 9 of 2.
+        tasks = np.array([0] * 60 + [1] * 3 + [2] * 9)
+        obs = np.zeros((len(tasks), 6), np.float32)
+        obs[np.arange(len(tasks)), 3 + tasks] = 1.0
+        chunk = Batch(
+            states=jnp.asarray(obs),
+            actions=jnp.zeros((len(tasks), 1)),
+            rewards=jnp.zeros(len(tasks)),
+            next_states=jnp.asarray(obs),
+            done=jnp.zeros(len(tasks)),
+        )
+        buf = push_striped(buf, chunk)
+        batch = jax.jit(
+            lambda b, k: sample_striped(b, k, 15)
+        )(buf, jax.random.key(0))
+        sampled_tasks = np.argmax(np.asarray(batch.states[:, 3:]), axis=-1)
+        np.testing.assert_array_equal(
+            np.bincount(sampled_tasks, minlength=t_dim), [5, 5, 5]
+        )
+
+    def test_striped_wraparound_saturates(self):
+        obs_spec = jax.ShapeDtypeStruct((6,), jnp.float32)
+        buf = init_striped_replay_buffer(12, obs_spec, 1, 3)  # 4 per stripe
+        obs = np.zeros((3, 6), np.float32)
+        obs[np.arange(3), 3 + np.arange(3)] = 1.0
+        chunk = Batch(
+            states=jnp.asarray(obs), actions=jnp.zeros((3, 1)),
+            rewards=jnp.zeros(3), next_states=jnp.asarray(obs),
+            done=jnp.zeros(3),
+        )
+        for _ in range(7):
+            buf = push_striped(buf, chunk)
+        np.testing.assert_array_equal(np.asarray(buf.size), [4, 4, 4])
+        np.testing.assert_array_equal(np.asarray(buf.ptr), [3, 3, 3])
+
+    def test_trains_with_striped_replay_and_per_task_metrics(self):
+        cfg = small_config()
+        env_cls, sac = _wrap_and_build(short_env(PendulumMultiTaskJax), cfg)
+        loop, _, buf, m = run_loop(
+            loop_class_for(env_cls), sac, env_cls, n_envs=8
+        )
+        assert isinstance(loop, ScenarioOnDeviceLoop)
+        assert isinstance(buf, StripedBufferState)
+        assert m["reward_per_task"].shape == (3,)
+        assert m["episodes_per_task"].shape == (3,)
+        assert float(jnp.sum(m["episodes_per_task"])) == float(m["episodes"])
+        host = split_scenario_metrics(jax.device_get(m))
+        assert {"reward_t0", "reward_t1", "reward_t2"} <= set(host)
+
+    def test_task_embedding_heads(self):
+        cfg = small_config(task_embed_dim=4)
+        env_cls, sac = _wrap_and_build(PendulumMultiTaskJax, cfg)
+        from torch_actor_critic_tpu.models import (
+            TaskConditionedActor,
+            TaskConditionedDoubleCritic,
+        )
+
+        assert isinstance(sac.actor_def, TaskConditionedActor)
+        assert isinstance(sac.critic_def, TaskConditionedDoubleCritic)
+        ts = sac.init_state(jax.random.key(0), jnp.zeros(env_cls.obs_dim))
+        obs = jnp.concatenate(
+            [jnp.ones((4, 3)), jax.nn.one_hot(jnp.arange(4) % 3, 3)], axis=-1
+        )
+        act, logp = sac.actor_def.apply(ts.actor_params, obs, jax.random.key(1))
+        assert act.shape == (4, 1) and np.all(np.isfinite(np.asarray(act)))
+        assert np.all(np.isfinite(np.asarray(logp)))
+        # The embedding conditions the policy: different tasks, same
+        # base features, different deterministic actions.
+        det, _ = sac.actor_def.apply(
+            ts.actor_params, obs, deterministic=True, with_logprob=False
+        )
+        assert not np.allclose(det[0], det[1])
+        q = sac.critic_def.apply(ts.critic_params, obs, act)
+        assert q.shape == (cfg.num_qs, 4)
+        assert np.all(np.isfinite(np.asarray(q)))
+
+    @pytest.mark.slow
+    def test_population_over_multitask(self):
+        """Member-vmapped scenario epochs (striped rings + per-task
+        extras under the population axis). Slow tier: the vmapped
+        compile is the costliest in this file, and the composition is
+        also gated by scenario_smoke's bitwise population resume."""
+        cfg = small_config()
+        env_cls, sac = _wrap_and_build(short_env(PendulumMultiTaskJax), cfg)
+        pop = PopulationOnDeviceLoop(sac, env_cls, n_members=2, n_envs=4)
+        assert isinstance(pop.inner, ScenarioOnDeviceLoop)
+        st, buf, es, keys, _ = pop.init(jax.random.key(0), buffer_capacity=3000)
+        st, buf, es, keys, m = pop.epoch(
+            st, buf, es, keys, steps=20, update_every=10
+        )
+        assert m["loss_q"].shape == (2,)
+        assert m["reward_per_task"].shape == (2, 3)
+        assert np.all(np.isfinite(np.asarray(m["loss_q"])))
+
+
+# ----------------------------------------------------------- history_env
+
+
+class TestHistoryComposition:
+    def test_history_over_procedural_preserves_level(self):
+        wrapped = history_env(HurdleRunnerJax, 4)
+        st = wrapped.reset(jax.random.key(0))
+        assert st.obs.shape == (4, HurdleRunnerJax.obs_dim)
+        level = HurdleRunnerJax.level_params(st.inner)
+        st2, out = jax.jit(wrapped.step)(st, jnp.zeros(2))
+        assert out.next_obs.shape == (4, HurdleRunnerJax.obs_dim)
+        level2 = HurdleRunnerJax.level_params(st2.inner)
+        # Mid-episode: the level rides the window unchanged.
+        np.testing.assert_array_equal(
+            np.asarray(level["hurdle_x"]), np.asarray(level2["hurdle_x"])
+        )
+
+    def test_history_forwards_scenario_attrs(self):
+        wrapped = history_env(PendulumMultiTaskJax, 3)
+        assert wrapped.n_tasks == 3
+        assert wrapped.base_obs_dim == 3
+        ma = history_env(multi_agent_pendulum(2), 3)
+        assert ma.n_agents == 2
+        assert ma.agent_obs_dim == 7
+
+    def test_striped_task_recovery_from_windowed_obs(self):
+        """The striped ring reads the task one-hot from the newest
+        frame of a history window."""
+        wrapped = history_env(PendulumMultiTaskJax, 3)
+        obs_spec = jax.ShapeDtypeStruct(wrapped.obs_shape, jnp.float32)
+        buf = init_striped_replay_buffer(30, obs_spec, 1, 3)
+        obs = np.zeros((6, 3, 6), np.float32)
+        tasks = np.array([2, 0, 1, 1, 0, 2])
+        obs[np.arange(6), :, 3 + tasks] = 1.0
+        chunk = Batch(
+            states=jnp.asarray(obs), actions=jnp.zeros((6, 1)),
+            rewards=jnp.zeros(6), next_states=jnp.asarray(obs),
+            done=jnp.zeros(6),
+        )
+        buf = push_striped(buf, chunk)
+        np.testing.assert_array_equal(np.asarray(buf.size), [2, 2, 2])
+
+    def test_multi_agent_history_fails_at_construction(self):
+        cfg = small_config(history_len=3)
+        with pytest.raises(ValueError, match="flat"):
+            _wrap_and_build(multi_agent_pendulum(2), cfg)
+
+
+# --------------------------------------------------------------- serving
+
+
+class TestServing:
+    @pytest.fixture(scope="class")
+    def multitask_setup(self):
+        cfg = small_config()
+        env_cls, sac = _wrap_and_build(PendulumMultiTaskJax, cfg)
+        ts = sac.init_state(jax.random.key(0), jnp.zeros(env_cls.obs_dim))
+        return env_cls, sac, ts
+
+    def test_slot_names(self, multitask_setup):
+        env_cls, _, _ = multitask_setup
+        assert scenario_slot_names(env_cls, "mt") == [
+            "mt/swingup", "mt/balance", "mt/spin",
+        ]
+        assert scenario_slot_names(HurdleRunnerJax, "hr") == ["hr"]
+
+    def test_task_slot_policy_pins_onehot(self, multitask_setup):
+        env_cls, sac, ts = multitask_setup
+        base_obs = jnp.linspace(-1.0, 1.0, 3)[None, :]
+        for task in range(env_cls.n_tasks):
+            policy = TaskSlotPolicy(sac.actor_def, env_cls.n_tasks, task)
+            a_slot, _ = policy.apply(
+                ts.actor_params, base_obs, deterministic=True,
+                with_logprob=False,
+            )
+            full = jnp.concatenate(
+                [base_obs, jax.nn.one_hot(task, 3)[None, :]], axis=-1
+            )
+            a_full, _ = sac.actor_def.apply(
+                ts.actor_params, full, deterministic=True, with_logprob=False
+            )
+            np.testing.assert_array_equal(
+                np.asarray(a_slot), np.asarray(a_full)
+            )
+
+    def test_per_task_slots_on_registry(self, multitask_setup):
+        from torch_actor_critic_tpu.serve.registry import ModelRegistry
+
+        env_cls, sac, ts = multitask_setup
+        registry = ModelRegistry()
+        names = register_scenario_slots(
+            registry, env_cls, sac.actor_def, name="pendulum-multitask",
+            params=ts.actor_params, max_batch=4, warmup=False,
+        )
+        assert set(names) == set(registry.slots())
+        assert len(names) == env_cls.n_tasks
+        for slot in names:
+            engine, params, generation = registry.acquire(slot)
+            assert generation == 0
+            act = engine.act(
+                params, jnp.zeros((2, 3)), key=jax.random.key(1),
+                deterministic=False,
+            )
+            assert np.asarray(act).shape == (2, env_cls.act_dim)
+            assert np.all(np.isfinite(np.asarray(act)))
+        registry.close()
+
+    def test_single_slot_scenarios(self):
+        from torch_actor_critic_tpu.serve.registry import ModelRegistry
+
+        cfg = small_config()
+        env_cls, sac = _wrap_and_build(multi_agent_pendulum(2), cfg)
+        ts = sac.init_state(jax.random.key(0), jnp.zeros(env_cls.obs_dim))
+        registry = ModelRegistry()
+        names = register_scenario_slots(
+            registry, env_cls, sac.actor_def, name="multi-pendulum-2",
+            params=ts.actor_params, max_batch=4, warmup=False,
+        )
+        assert names == ["multi-pendulum-2"]
+        engine, params, _ = registry.acquire(names[0])
+        act = engine.act(
+            params, jnp.zeros((1, env_cls.obs_dim)), key=jax.random.key(2),
+            deterministic=False,
+        )
+        assert np.asarray(act).shape == (1, env_cls.act_dim)
+        registry.close()
+
+
+# -------------------------------------------------- analysis/cost wiring
+
+
+class TestAnalysisWiring:
+    def test_scenario_epoch_is_a_registered_entry_point(self):
+        from torch_actor_critic_tpu.analysis.reachability import ENTRY_POINTS
+
+        assert ScenarioOnDeviceLoop.epoch_cost_name == "train/scenario_epoch"
+        suffix, builder = ENTRY_POINTS["train/scenario_epoch"]
+        assert suffix == "scenarios/loop.py"
+        assert builder == "ScenarioOnDeviceLoop._build_epoch"
+
+    def test_scenario_epoch_registers_with_cost_registry(self):
+        from torch_actor_critic_tpu.telemetry.costmodel import CostRegistry
+
+        cfg = small_config()
+        env_cls, sac = _wrap_and_build(short_env(PendulumMultiTaskJax), cfg)
+        loop = ScenarioOnDeviceLoop(sac, env_cls, n_envs=4)
+        ts, buf, es, key = loop.init(jax.random.key(0), buffer_capacity=3000)
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            (ts, buf, es, key),
+        )
+        ts, buf, es, key, _ = loop.epoch(
+            ts, buf, es, key, steps=10, update_every=10, warmup=True
+        )
+        fn = loop.epoch_jit(10, 10, True)
+        assert fn is not None
+        registry = CostRegistry()
+        registry.register_jit(loop.epoch_cost_name, fn, *abstract)
+        cost = registry.get(loop.epoch_cost_name)
+        assert cost is not None and cost["flops"] > 0

@@ -10,7 +10,8 @@ module computes the traced set:
   any file. Lambdas passed to wrappers are roots too.
 * **Seeds** — the walk is anchored on the CostRegistry/watchdog source
   names (``train/update_burst``, ``train/ondevice_epoch``,
-  ``train/population_epoch``, ``serve/forward``): the builders that
+  ``train/population_epoch``, ``train/scenario_epoch``,
+  ``serve/forward``): the builders that
   register those programs are listed in :data:`ENTRY_POINTS`, and the
   pass verifies each one still exists and still constructs a jit root
   — a renamed builder raises ``stale-entry-point`` instead of the walk
@@ -76,6 +77,9 @@ ENTRY_POINTS: t.Dict[str, t.Tuple[str, str]] = {
     "train/ondevice_epoch": ("sac/ondevice.py", "OnDeviceLoop._build_epoch"),
     "train/population_epoch": (
         "sac/ondevice.py", "PopulationOnDeviceLoop._build_epoch",
+    ),
+    "train/scenario_epoch": (
+        "scenarios/loop.py", "ScenarioOnDeviceLoop._build_epoch",
     ),
     "serve/forward": ("serve/engine.py", "PolicyEngine.__init__"),
 }

@@ -5,3 +5,9 @@ from torch_actor_critic_tpu.buffer.replay import (  # noqa: F401
     sample,
     sample_fused_visual,
 )
+from torch_actor_critic_tpu.buffer.striped import (  # noqa: F401
+    StripedBufferState,
+    init_striped_replay_buffer,
+    push_striped,
+    sample_striped,
+)

@@ -44,6 +44,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torch_actor_critic_tpu.buffer.striped import (
+    StripedBufferState,
+    push_striped,
+    sample_striped,
+)
 from torch_actor_critic_tpu.core.types import Batch, BufferState, MultiObservation
 
 
@@ -164,7 +169,14 @@ def push(state: BufferState, chunk: Batch) -> BufferState:
     ``(ptr + arange(n)) % capacity``, then advances ``ptr`` and
     saturates ``size`` at capacity. ``n`` must be static (it is: the
     trainer always pushes ``update_every``-sized chunks).
+
+    A striped (per-task) ring dispatches to
+    :func:`~torch_actor_critic_tpu.buffer.striped.push_striped` — the
+    one integration point that lets the fused burst/epoch programs ride
+    either ring unchanged.
     """
+    if isinstance(state, StripedBufferState):
+        return push_striped(state, chunk)
     capacity = state.capacity
     n = jax.tree_util.tree_leaves(chunk)[0].shape[0]
     if n > capacity:
@@ -197,7 +209,13 @@ def sample(state: BufferState, key: jax.Array, batch_size: int) -> Batch:
     cannot be checked, so the index range is clamped to ``[0, 1)`` —
     callers must gate on ``size > 0`` (the trainer's ``update_after``
     warmup guarantees this, ref ``sac/algorithm.py:273``).
+
+    A striped (per-task) ring dispatches to
+    :func:`~torch_actor_critic_tpu.buffer.striped.sample_striped`
+    (task-balanced draws), mirroring :func:`push`.
     """
+    if isinstance(state, StripedBufferState):
+        return sample_striped(state, key, batch_size)
     if not isinstance(state.size, jax.core.Tracer) and int(state.size) == 0:
         raise ValueError("sample: replay buffer is empty (size == 0).")
     idx = jax.random.randint(key, (batch_size,), 0, jnp.maximum(state.size, 1))

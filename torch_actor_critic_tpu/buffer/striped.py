@@ -1,0 +1,189 @@
+"""Per-task striped ring replay: one HBM ring stripe per task.
+
+Multi-task training (``scenarios/multitask.py``) needs replay that
+stays balanced across tasks even when the collected stream does not:
+exploration collapsing onto one task's envs must not starve the other
+tasks' gradient signal. The uniform ring (``buffer/replay.py``) cannot
+express that — a uniform draw over one ring samples tasks at whatever
+ratio they were pushed.
+
+The striped ring partitions the capacity into ``n_stripes`` independent
+sub-rings (one leading stripe axis on every data leaf, per-stripe
+``ptr``/``size`` cursors). Everything stays jit-pure and shape-static:
+
+- :func:`push_striped` routes each transition of a chunk to its task's
+  stripe in ONE scatter — the task id is recovered from the task
+  one-hot that (by the scenarios/ convention) occupies the trailing
+  ``n_stripes`` dims of the flat observation, the within-chunk write
+  ranks come from a cumulative-sum over the one-hot matrix, and the
+  write indices are ``(task, (ptr[task] + rank) % capacity)``. No
+  data-dependent shapes anywhere.
+- :func:`sample_striped` draws ``batch_size / n_stripes`` rows from
+  every stripe (remainder spread over the first stripes) — per-task
+  replay striping: every gradient step sees every task.
+
+The generic :func:`buffer.replay.push`/``sample`` entry points
+dispatch here on the state type, so the fused epoch program, SAC/TD3
+bursts and the population loop all ride the striped ring with zero
+call-site changes.
+
+HBM budget: a striped ring occupies exactly what a uniform ring of the
+same total capacity would (`capacity` here is PER STRIPE; total rows =
+``n_stripes * capacity``) — see docs/SCENARIOS.md.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from torch_actor_critic_tpu.core.types import Batch
+
+
+@struct.dataclass
+class StripedBufferState:
+    """Functional striped-ring state: ``data`` leaves carry a leading
+    ``(n_stripes, capacity)`` pair of axes; ``ptr``/``size`` are
+    per-stripe ``(n_stripes,)`` cursors."""
+
+    data: Batch
+    ptr: jax.Array  # (n_stripes,) int32: next write slot per stripe
+    size: jax.Array  # (n_stripes,) int32: valid rows per stripe
+
+    @property
+    def n_stripes(self) -> int:
+        return jax.tree_util.tree_leaves(self.data)[0].shape[0]
+
+    @property
+    def capacity(self) -> int:
+        """Per-stripe capacity (total rows = n_stripes * capacity)."""
+        return jax.tree_util.tree_leaves(self.data)[0].shape[1]
+
+
+def init_striped_replay_buffer(
+    capacity: int,
+    obs_spec: t.Any,
+    act_dim: int,
+    n_stripes: int,
+    act_dtype=jnp.float32,
+) -> StripedBufferState:
+    """Preallocate an empty striped ring. ``capacity`` is the TOTAL
+    row budget (matching :func:`buffer.replay.init_replay_buffer`'s
+    meaning so config ``buffer_size`` keeps its HBM semantics); it is
+    split evenly into ``n_stripes`` sub-rings."""
+    if n_stripes < 2:
+        raise ValueError(
+            f"striped replay needs >= 2 stripes, got {n_stripes}"
+        )
+    per_stripe = capacity // n_stripes
+    if per_stripe < 1:
+        raise ValueError(
+            f"capacity {capacity} cannot cover {n_stripes} stripes"
+        )
+
+    def zeros(spec):
+        return jnp.zeros(
+            (n_stripes, per_stripe) + tuple(spec.shape), spec.dtype
+        )
+
+    data = Batch(
+        states=jax.tree_util.tree_map(zeros, obs_spec),
+        actions=jnp.zeros((n_stripes, per_stripe, act_dim), act_dtype),
+        rewards=jnp.zeros((n_stripes, per_stripe), jnp.float32),
+        next_states=jax.tree_util.tree_map(zeros, obs_spec),
+        done=jnp.zeros((n_stripes, per_stripe), jnp.float32),
+    )
+    return StripedBufferState(
+        data=data,
+        ptr=jnp.zeros(n_stripes, jnp.int32),
+        size=jnp.zeros(n_stripes, jnp.int32),
+    )
+
+
+def _chunk_task_ids(chunk: Batch, n_stripes: int) -> jax.Array:
+    """Recover per-row task ids from the task one-hot in the trailing
+    ``n_stripes`` dims of the flat observation (newest frame when the
+    obs is a history window)."""
+    oh = chunk.states[..., -n_stripes:]
+    # (n, ..., T) -> (n, T): a history window repeats the one-hot in
+    # every frame; read it from the newest one.
+    oh = oh.reshape(oh.shape[0], -1, n_stripes)[:, -1, :]
+    return jnp.argmax(oh, axis=-1).astype(jnp.int32)
+
+
+def push_striped(state: StripedBufferState, chunk: Batch) -> StripedBufferState:
+    """Append a chunk, routing every transition to its task's stripe.
+
+    Equivalent of per-stripe :func:`buffer.replay.push` calls fused
+    into one scatter: row ``i`` with task ``s_i`` lands at
+    ``(s_i, (ptr[s_i] + rank_i) % capacity)`` where ``rank_i`` counts
+    the chunk's earlier rows of the same task — so write slots are
+    unique by construction and each stripe wraps independently.
+    """
+    capacity = state.capacity
+    n_stripes = state.n_stripes
+    n = jax.tree_util.tree_leaves(chunk)[0].shape[0]
+    if n > capacity:
+        # Worst case (every row one task) would scatter duplicate
+        # slots, overwriting in unspecified order — same guard as the
+        # uniform ring's push.
+        raise ValueError(
+            f"push_striped: chunk of {n} transitions exceeds per-stripe "
+            f"capacity {capacity}; use a larger buffer or smaller chunks."
+        )
+    task = _chunk_task_ids(chunk, n_stripes)
+    onehot = jax.nn.one_hot(task, n_stripes, dtype=jnp.int32)  # (n, T)
+    counts = jnp.sum(onehot, axis=0)  # (T,)
+    # Exclusive running count of same-task rows before each row.
+    rank = (jnp.cumsum(onehot, axis=0) - onehot)[jnp.arange(n), task]
+    slot = (state.ptr[task] + rank) % capacity
+
+    data = jax.tree_util.tree_map(
+        lambda ring, new: ring.at[task, slot].set(new), state.data, chunk
+    )
+    return StripedBufferState(
+        data=data,
+        ptr=(state.ptr + counts) % capacity,
+        size=jnp.minimum(state.size + counts, capacity),
+    )
+
+
+def sample_striped(
+    state: StripedBufferState, key: jax.Array, batch_size: int
+) -> Batch:
+    """Draw a task-balanced batch: ``batch_size // n_stripes`` rows per
+    stripe (remainder to the first stripes), uniform with replacement
+    within each stripe's valid region — the per-task replay striping
+    guarantee. Row draws use per-stripe ``fold_in`` keys (a new
+    subsystem: no bitwise-parity constraint against the uniform ring).
+
+    An unfilled stripe samples its zero rows until its task's envs
+    push (the warmup phase covers this exactly like the uniform ring's
+    ``size > 0`` gate); a concretely all-empty ring raises eagerly.
+    """
+    if not isinstance(state.size, jax.core.Tracer) and (
+        int(jnp.sum(state.size)) == 0
+    ):
+        raise ValueError("sample_striped: replay buffer is empty.")
+    n_stripes = state.n_stripes
+    base, rem = divmod(batch_size, n_stripes)
+    parts = []
+    for stripe in range(n_stripes):
+        n_rows = base + (1 if stripe < rem else 0)
+        if n_rows == 0:
+            continue
+        idx = jax.random.randint(
+            jax.random.fold_in(key, stripe),
+            (n_rows,), 0, jnp.maximum(state.size[stripe], 1),
+        )
+
+        def take(ring, stripe=stripe, idx=idx):
+            return jnp.take(ring[stripe], idx, axis=0)
+
+        parts.append(jax.tree_util.tree_map(take, state.data))
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *parts
+    )

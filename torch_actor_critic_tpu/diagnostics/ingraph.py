@@ -68,6 +68,7 @@ __all__ = [
     "replica_skew",
     "saturation_fraction",
     "split_member_metrics",
+    "split_scenario_metrics",
 ]
 
 # TD-error magnitude bucket spec: |TD| from 1e-3 to 1e4 at the same
@@ -200,6 +201,43 @@ def split_member_metrics(metrics: t.Mapping[str, t.Any]) -> dict:
             else finite.min() if r == "min"
             else finite.mean()
         )
+    return out
+
+
+# Scenario metric axes (scenarios/, docs/SCENARIOS.md): an in-graph
+# metric key ending `_per_<axis>` carries one value per agent/task;
+# the host expands it with the matching short suffix — the `_m{i}`
+# member convention applied to the scenario axes (`reward_per_task`
+# (T,) -> `reward_t0..T-1`).
+_SCENARIO_AXES = {"agent": "a", "task": "t"}
+
+
+def split_scenario_metrics(metrics: t.Mapping[str, t.Any]) -> dict:
+    """Host-side scenario metric layout for the fused-loop drivers.
+
+    Scalars become plain floats — on a classic single-agent run this
+    is EXACTLY the historical ``{k: float(v)}`` (pinned by tests).
+    ``{base}_per_agent``/``{base}_per_task`` vectors expand to
+    ``{base}_a{i}`` / ``{base}_t{i}`` scalars; any other vector metric
+    falls back to ``{key}_{i}`` indexing so nothing is silently
+    dropped.
+    """
+    out: dict = {}
+    for k, v in metrics.items():
+        arr = np.asarray(v)
+        if arr.ndim == 0:
+            out[k] = float(arr)
+            continue
+        for axis, short in _SCENARIO_AXES.items():
+            suffix = f"_per_{axis}"
+            if k.endswith(suffix):
+                base = k[: -len(suffix)]
+                for i, x in enumerate(arr.ravel()):
+                    out[f"{base}_{short}{i}"] = float(x)
+                break
+        else:
+            for i, x in enumerate(arr.ravel()):
+                out[f"{k}_{i}"] = float(x)
     return out
 
 

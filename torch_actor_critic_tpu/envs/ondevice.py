@@ -58,6 +58,14 @@ class StepOut:
     terminated: jax.Array  # float 0/1: Bellman done mask (not truncation)
     ended: jax.Array  # bool: episode finished; env auto-reset
     final_return: jax.Array  # episode return; meaningful when `ended`
+    # Optional per-step metric components a scenario env reports beyond
+    # the scalar protocol: a dict of arrays (e.g. ``return_per_agent``
+    # (n_agents,), ``episodes_per_task`` (n_tasks,)) the scenario loop
+    # sum-accumulates over the epoch (scenarios/loop.py). ``None`` for
+    # the classic single-agent envs — a None field contributes no
+    # pytree leaves, so their states, programs and checkpoints are
+    # byte-identical to the pre-scenarios builds.
+    extras: t.Any = None
 
 
 class PendulumJax:
@@ -496,15 +504,30 @@ ON_DEVICE_ENVS = {
 _SURROGATE_DYNAMICS = {"HalfCheetah-v3", "HalfCheetah-v4", "HalfCheetah-v5"}
 
 
+def known_on_device_envs() -> list:
+    """Every name with a pure-JAX twin: the classic single-agent
+    registry above plus the scenarios/ registry (multi-agent,
+    procedural, multi-task) — the ONE list unknown-name errors cite."""
+    from torch_actor_critic_tpu.scenarios import scenario_names
+
+    return sorted(ON_DEVICE_ENVS) + scenario_names()
+
+
 def get_on_device_env(name: str):
     """Registry lookup; None when the task has no pure-JAX twin (host
-    envs remain the general path).
+    envs remain the general path). Scenario workloads (the
+    ``scenarios/`` registry: multi-agent, procedural, multi-task)
+    resolve here too, so every on-device entry point accepts them.
 
     Resolving a real gym ID to a surrogate-dynamics twin logs a warning:
     throughput/scaling numbers transfer, return values do NOT — anyone
     comparing returns against a MuJoCo run must see the substitution.
     """
     env = ON_DEVICE_ENVS.get(name)
+    if env is None:
+        from torch_actor_critic_tpu.scenarios import SCENARIO_ENVS
+
+        env = SCENARIO_ENVS.get(name)
     if env is not None and name in _SURROGATE_DYNAMICS:
         logging.getLogger(__name__).warning(
             "on-device env for %r uses SURROGATE dynamics (%s): throughput "
@@ -589,4 +612,13 @@ def history_env(base_cls, horizon: int):
 
     HistoryJax.__name__ = f"History{horizon}x{base_cls.__name__}"
     HistoryJax.__qualname__ = HistoryJax.__name__
+    # Scenario protocol attributes ride through the adapter: model
+    # dispatch (build_models) and the striped replay derive agent/task
+    # structure from the env class, and the window must not hide it.
+    # Level parameters need no forwarding — the base env's full
+    # EnvState (level included) rides in ``EnvState.inner``.
+    for attr in ("n_agents", "agent_obs_dim", "n_tasks", "base_obs_dim",
+                 "task_names"):
+        if hasattr(base_cls, attr):
+            setattr(HistoryJax, attr, getattr(base_cls, attr))
     return HistoryJax

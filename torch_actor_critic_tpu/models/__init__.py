@@ -15,3 +15,11 @@ from torch_actor_critic_tpu.models.sequence import (  # noqa: F401
     SequenceDoubleCritic,
     SequenceTrunk,
 )
+from torch_actor_critic_tpu.models.multiagent import (  # noqa: F401
+    MultiAgentActor,
+    MultiAgentDoubleCritic,
+)
+from torch_actor_critic_tpu.models.taskembed import (  # noqa: F401
+    TaskConditionedActor,
+    TaskConditionedDoubleCritic,
+)

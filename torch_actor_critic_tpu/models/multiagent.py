@@ -1,0 +1,168 @@
+"""Per-agent policy/critic heads for the multi-agent scenarios.
+
+The agent axis uses the SAME machinery the PR-6 population and the
+``DoubleCritic`` ensemble ride: ``nn.vmap`` with
+``variable_axes={"params": 0}``, so N agents' independent MLP heads
+batch onto the MXU as one set of stacked matmuls — one weight fetch, N
+agents of useful FLOPs — instead of N sequential small kernels.
+
+Factorization contract (shared with ``scenarios/multiagent.py``):
+
+- the *joint* observation is the flat concatenation of ``n_agents``
+  per-agent observations (``agent_obs_dim`` each); the joint action is
+  the concatenation of per-agent actions;
+- :class:`MultiAgentActor` samples each agent's action from its OWN
+  squashed-Gaussian head over its OWN observation slice (decentralized
+  execution); the joint log-prob is the per-agent sum, which is exactly
+  what one diagonal Gaussian over the concatenated action computes —
+  so SAC's entropy machinery applies unchanged;
+- training is centralized (CTDE): the default critic is the plain
+  :class:`~torch_actor_critic_tpu.models.critic.DoubleCritic` over the
+  joint (obs, action) — no new critic code needed; the alternative
+  :class:`MultiAgentDoubleCritic` is the VDN-style decomposition
+  (per-agent twin critics over local slices, summed into the joint Q),
+  selected by ``config.ma_critic="per_agent"``.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from torch_actor_critic_tpu.models.mlp import MLP, Dense
+from torch_actor_critic_tpu.ops.distributions import squashed_gaussian_sample
+
+
+class _AgentGaussianHeads(nn.Module):
+    """One agent's trunk + (mu, log_std) heads over its local obs."""
+
+    act_dim: int
+    hidden_sizes: t.Sequence[int]
+    dtype: t.Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array):
+        trunk = MLP(self.hidden_sizes, activate_final=True, dtype=self.dtype)(obs)
+        mu = Dense(self.act_dim, dtype=self.dtype)(trunk)
+        log_std = Dense(self.act_dim, dtype=self.dtype)(trunk)
+        return mu, log_std
+
+
+class _AgentQ(nn.Module):
+    """One agent's Q over its local (obs, action) slice."""
+
+    hidden_sizes: t.Sequence[int]
+    dtype: t.Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+        x = MLP(tuple(self.hidden_sizes) + (1,), activate_final=False,
+                dtype=self.dtype)(x)
+        return jnp.squeeze(x, axis=-1)
+
+
+class MultiAgentActor(nn.Module):
+    """N independent squashed-Gaussian heads over per-agent obs slices.
+
+    Honors the shared actor contract
+    ``apply(params, obs, key, deterministic, with_logprob) ->
+    (action, logp)`` with the joint flat obs/action, so the fused loop,
+    SAC losses and the serving engine use it like any other actor.
+    """
+
+    n_agents: int
+    agent_obs_dim: int
+    act_dim: int  # joint: n_agents * per-agent act dim
+    hidden_sizes: t.Sequence[int] = (256, 256)
+    act_limit: float = 1.0
+    dtype: t.Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        obs: jax.Array,
+        key: jax.Array | None = None,
+        deterministic: bool = False,
+        with_logprob: bool = True,
+    ):
+        if self.act_dim % self.n_agents:
+            raise ValueError(
+                f"joint act_dim {self.act_dim} must split evenly over "
+                f"{self.n_agents} agents"
+            )
+        agent_act = self.act_dim // self.n_agents
+        batch_shape = obs.shape[:-1]
+        per = obs.reshape(
+            batch_shape + (self.n_agents, self.agent_obs_dim)
+        )
+        heads = nn.vmap(
+            _AgentGaussianHeads,
+            in_axes=-2,
+            out_axes=-2,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )(
+            act_dim=agent_act,
+            hidden_sizes=self.hidden_sizes,
+            dtype=self.dtype,
+            name="agents",
+        )
+        mu, log_std = heads(per)  # (..., n_agents, agent_act)
+        # Joint diagonal Gaussian over the concatenated action: the
+        # sample factorizes per agent and the log-prob sums per agent —
+        # the product policy, via the ONE shared sampling op.
+        mu = mu.reshape(batch_shape + (self.act_dim,)).astype(jnp.float32)
+        log_std = log_std.reshape(batch_shape + (self.act_dim,)).astype(
+            jnp.float32
+        )
+        return squashed_gaussian_sample(
+            key, mu, log_std, self.act_limit, deterministic, with_logprob
+        )
+
+
+class MultiAgentDoubleCritic(nn.Module):
+    """VDN-style twin critics: per-agent Q over local slices, summed.
+
+    Returns ``(num_qs, batch)`` like ``DoubleCritic`` — the joint Q is
+    the sum of per-agent utilities, so the SAC losses are unchanged.
+    The per-agent axis and the twin-Q ensemble are BOTH ``nn.vmap``
+    parameter axes (agents inside, ensemble outside).
+    """
+
+    n_agents: int
+    agent_obs_dim: int
+    agent_act_dim: int
+    hidden_sizes: t.Sequence[int] = (256, 256)
+    num_qs: int = 2
+    dtype: t.Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        batch_shape = obs.shape[:-1]
+        per_obs = obs.reshape(
+            batch_shape + (self.n_agents, self.agent_obs_dim)
+        )
+        per_act = action.reshape(
+            batch_shape + (self.n_agents, self.agent_act_dim)
+        )
+        per_agent = nn.vmap(
+            _AgentQ,
+            in_axes=(-2, -2),
+            out_axes=-1,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )
+        ensemble = nn.vmap(
+            per_agent,
+            in_axes=(None, None),
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            axis_size=self.num_qs,
+        )(hidden_sizes=self.hidden_sizes, dtype=self.dtype, name="ensemble")
+        q_per_agent = ensemble(per_obs, per_act)  # (num_qs, ..., n_agents)
+        return jnp.sum(q_per_agent.astype(jnp.float32), axis=-1)

@@ -1,0 +1,107 @@
+"""Task-conditioned heads: learned task embedding over the one-hot.
+
+With ``task_embed_dim == 0`` (the default) a multi-task scenario needs
+no special models at all — the task one-hot is simply part of the flat
+observation and the plain :class:`~torch_actor_critic_tpu.models.actor.
+Actor`/``DoubleCritic`` condition on it like any other feature. These
+modules are the opt-in upgrade (``config.task_embed_dim > 0``): the
+trailing ``n_tasks`` one-hot dims are projected through a learned
+linear embedding before joining the proprioceptive features, so tasks
+share structure in embedding space instead of owning disjoint one-hot
+columns — the standard multi-task conditioning lever once the task
+count grows past a handful.
+
+Both honor the exact actor/critic contracts, so every downstream
+surface (fused loop, losses, serving engine, checkpoints) is
+indifferent to which conditioning is active.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from torch_actor_critic_tpu.models.mlp import MLP, Dense
+from torch_actor_critic_tpu.ops.distributions import squashed_gaussian_sample
+
+
+def _embed_obs(obs: jax.Array, n_tasks: int, embed_dim: int, dtype) -> jax.Array:
+    """Split the trailing task one-hot off, embed it, rejoin."""
+    base, onehot = obs[..., :-n_tasks], obs[..., -n_tasks:]
+    emb = Dense(embed_dim, dtype=dtype, name="task_embed")(onehot)
+    return jnp.concatenate([base, emb], axis=-1)
+
+
+class TaskConditionedActor(nn.Module):
+    """Squashed-Gaussian actor over (features, task-embedding)."""
+
+    n_tasks: int
+    task_embed_dim: int
+    act_dim: int
+    hidden_sizes: t.Sequence[int] = (256, 256)
+    act_limit: float = 1.0
+    dtype: t.Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        obs: jax.Array,
+        key: jax.Array | None = None,
+        deterministic: bool = False,
+        with_logprob: bool = True,
+    ):
+        x = _embed_obs(obs, self.n_tasks, self.task_embed_dim, self.dtype)
+        trunk = MLP(self.hidden_sizes, activate_final=True, dtype=self.dtype)(x)
+        mu = Dense(self.act_dim, dtype=self.dtype)(trunk).astype(jnp.float32)
+        log_std = Dense(self.act_dim, dtype=self.dtype)(trunk).astype(
+            jnp.float32
+        )
+        return squashed_gaussian_sample(
+            key, mu, log_std, self.act_limit, deterministic, with_logprob
+        )
+
+
+class _TaskQ(nn.Module):
+    n_tasks: int
+    task_embed_dim: int
+    hidden_sizes: t.Sequence[int]
+    dtype: t.Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        x = _embed_obs(obs, self.n_tasks, self.task_embed_dim, self.dtype)
+        x = jnp.concatenate([x, action], axis=-1)
+        x = MLP(tuple(self.hidden_sizes) + (1,), activate_final=False,
+                dtype=self.dtype)(x)
+        return jnp.squeeze(x.astype(jnp.float32), axis=-1)
+
+
+class TaskConditionedDoubleCritic(nn.Module):
+    """Twin task-conditioned critics; returns ``(num_qs, batch)``."""
+
+    n_tasks: int
+    task_embed_dim: int
+    hidden_sizes: t.Sequence[int] = (256, 256)
+    num_qs: int = 2
+    dtype: t.Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        ensemble = nn.vmap(
+            _TaskQ,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=None,
+            out_axes=0,
+            axis_size=self.num_qs,
+        )
+        return ensemble(
+            n_tasks=self.n_tasks,
+            task_embed_dim=self.task_embed_dim,
+            hidden_sizes=self.hidden_sizes,
+            dtype=self.dtype,
+            name="ensemble",
+        )(obs, action)

@@ -103,7 +103,7 @@ class OnDeviceLoop:
             advice="reduce buffer_capacity (or history_len)",
         )
         train_state = self.sac.init_state(k_state, zero_obs)
-        buffer = init_replay_buffer(buffer_capacity, obs_spec, self.env.act_dim)
+        buffer = self._init_buffer(buffer_capacity, obs_spec)
         if self.mesh is None:
             env_states = jax.vmap(self.env.reset)(
                 jax.random.split(k_envs, self.n_envs)
@@ -127,6 +127,12 @@ class OnDeviceLoop:
         )
         env_states = put(lambda x: jax.device_put(x, dp_sharding), env_states)
         return train_state, buffer, env_states, k_act
+
+    def _init_buffer(self, buffer_capacity: int, obs_spec):
+        """Replay-ring constructor hook: the scenario loop overrides it
+        to build the per-task striped ring (``buffer/striped.py``) for
+        multi-task envs; the base loop's ring is unchanged."""
+        return init_replay_buffer(buffer_capacity, obs_spec, self.env.act_dim)
 
     # ----------------------------------------------------------------- epoch
 
@@ -235,6 +241,19 @@ class OnDeviceLoop:
         return train_state, buffer, env_states, act_key, raw
 
     @staticmethod
+    def _cross_replica_raw(raw: Metrics, axis: str) -> Metrics:
+        """dp reduction of the epoch-body raw stats (losses averaged,
+        counts/returns summed) — a hook so the scenario loop can reduce
+        its extra per-agent/per-task keys; the base ops are verbatim
+        the historical inline dict (bitwise-pinned)."""
+        return {
+            "loss_q": jax.lax.pmean(raw["loss_q"], axis),
+            "loss_pi": jax.lax.pmean(raw["loss_pi"], axis),
+            "episodes": jax.lax.psum(raw["episodes"], axis),
+            "return_sum": jax.lax.psum(raw["return_sum"], axis),
+        }
+
+    @staticmethod
     def _finalize_metrics(raw: Metrics) -> Metrics:
         episodes = raw["episodes"]
         return {
@@ -290,12 +309,7 @@ class OnDeviceLoop:
                     local, buf, es, key,
                     n_windows, update_every, warmup, axis_name=axis,
                 )
-                raw = {
-                    "loss_q": jax.lax.pmean(raw["loss_q"], axis),
-                    "loss_pi": jax.lax.pmean(raw["loss_pi"], axis),
-                    "episodes": jax.lax.psum(raw["episodes"], axis),
-                    "return_sum": jax.lax.psum(raw["return_sum"], axis),
-                }
+                raw = self._cross_replica_raw(raw, axis)
                 return ts, buf, es, raw
 
             ts_all, buffer, env_states, raw = jax.vmap(
@@ -358,6 +372,25 @@ class OnDeviceLoop:
         its first dispatch) — the cost registry lowers this with
         abstract args (telemetry/costmodel.py)."""
         return self._epoch_fns.get((steps, update_every, warmup))
+
+
+def loop_class_for(env_cls) -> type:
+    """Pick the fused-loop class for an env class: scenario envs (a
+    multi-agent or multi-task structure advertised by ``n_agents`` /
+    ``n_tasks`` class attributes) train under
+    :class:`~torch_actor_critic_tpu.scenarios.loop.ScenarioOnDeviceLoop`
+    (per-agent/per-task metrics, striped replay, its own watchdog/cost
+    entry point); everything else — including the purely procedural
+    family, which needs no epoch changes — stays on the bitwise-pinned
+    base :class:`OnDeviceLoop`."""
+    if (
+        getattr(env_cls, "n_agents", 1) > 1
+        or getattr(env_cls, "n_tasks", 0) > 1
+    ):
+        from torch_actor_critic_tpu.scenarios.loop import ScenarioOnDeviceLoop
+
+        return ScenarioOnDeviceLoop
+    return OnDeviceLoop
 
 
 @struct.dataclass
@@ -458,7 +491,10 @@ class PopulationOnDeviceLoop:
                 )
             self._member_sharding = NamedSharding(mesh, P("dp"))
             self._rep_sharding = NamedSharding(mesh, P())
-        self.inner = OnDeviceLoop(sac, env_cls, n_envs=n_envs)
+        # Scenario envs route the member program through the scenario
+        # loop (striped replay, per-agent/per-task stats); classic envs
+        # keep the bitwise-pinned base body.
+        self.inner = loop_class_for(env_cls)(sac, env_cls, n_envs=n_envs)
         self._epoch_fns: dict = {}
         self._pbt_fn = None
         self._ema_fn = None
@@ -498,9 +534,7 @@ class PopulationOnDeviceLoop:
         def member_init(k):
             k_state, k_envs, k_act = jax.random.split(k, 3)
             ts = self.sac.init_state(k_state, zero_obs)
-            buf = init_replay_buffer(
-                buffer_capacity, obs_spec, env.act_dim
-            )
+            buf = self.inner._init_buffer(buffer_capacity, obs_spec)
             es = jax.vmap(env.reset)(jax.random.split(k_envs, n_envs))
             return ts, buf, es, k_act
 
@@ -567,12 +601,16 @@ class PopulationOnDeviceLoop:
             state, buffer, env_states, act_keys, raw = jax.vmap(
                 member_epoch
             )(state, buffer, env_states, act_keys)
-            # _finalize_metrics is elementwise, so it maps over the
-            # member axis unchanged: every metric keeps shape (N,) — N
-            # real learning curves, never one averaged one.
+            # _finalize_metrics is elementwise (broadcasting over any
+            # trailing agent/task axis), so it maps over the member
+            # axis unchanged: every metric keeps its leading (N,) — N
+            # real learning curves, never one averaged one. Routed
+            # through the inner loop so scenario envs finalize their
+            # per-agent/per-task extras; for classic envs this IS
+            # OnDeviceLoop._finalize_metrics.
             return (
                 state, buffer, env_states, act_keys,
-                OnDeviceLoop._finalize_metrics(raw),
+                inner._finalize_metrics(raw),
             )
 
         if self._member_sharding is None:
@@ -783,6 +821,13 @@ class _SpecView:
         self.obs_spec, _ = _env_obs_spec(env_cls)
         self.act_dim = env_cls.act_dim
         self.act_limit = env_cls.act_limit
+        # Scenario structure (scenarios/): multi-agent factorization
+        # and multi-task conditioning ride the env class so
+        # build_models can dispatch to the per-agent / task-embedding
+        # heads. Defaults leave classic envs untouched.
+        self.n_agents = getattr(env_cls, "n_agents", 1)
+        self.agent_obs_dim = getattr(env_cls, "agent_obs_dim", 0)
+        self.n_tasks = getattr(env_cls, "n_tasks", 0)
 
 
 def _wrap_and_build(env_cls, config) -> t.Tuple[t.Any, SAC]:
@@ -900,9 +945,12 @@ def train_on_device(
     """
     import numpy as np
 
+    from torch_actor_critic_tpu.diagnostics.ingraph import (
+        split_scenario_metrics,
+    )
     from torch_actor_critic_tpu.envs.ondevice import (
-        ON_DEVICE_ENVS,
         get_on_device_env,
+        known_on_device_envs,
     )
     from torch_actor_critic_tpu.parallel.distributed import is_coordinator
 
@@ -910,12 +958,16 @@ def train_on_device(
     if env_cls is None:
         raise ValueError(
             f"{env_name!r} has no pure-JAX twin; on-device training "
-            f"supports {sorted(ON_DEVICE_ENVS)}"
+            f"supports {known_on_device_envs()}"
         )
     # history_len > 1 windows the env on-chip (fused HistoryEnv twin)
     # and dispatches to the causal-transformer stack via build_models.
     env_cls, sac = _wrap_and_build(env_cls, config)
-    loop = OnDeviceLoop(sac, env_cls, n_envs=config.on_device_envs, mesh=mesh)
+    # Scenario envs (multi-agent/multi-task structure) train under the
+    # scenario loop; classic envs keep the bitwise-pinned base program.
+    loop = loop_class_for(env_cls)(
+        sac, env_cls, n_envs=config.on_device_envs, mesh=mesh
+    )
     state, buffer, env_states, act_key = loop.init(
         jax.random.key(seed), buffer_capacity=config.buffer_size
     )
@@ -953,10 +1005,12 @@ def train_on_device(
         )
         # Host-fetch drain before reading the clock (utils/sync.py:
         # block_until_ready is not a true barrier on the axon backend).
-        # The float() fetches below would drain too, but the timing
+        # The host fetches below would drain too, but the timing
         # contract should not hinge on dict iteration order.
         drain(m["loss_q"])
-        metrics = {k: float(v) for k, v in m.items()}
+        # Scalar metrics become floats exactly as before; scenario
+        # per-axis vectors expand to the _a{i}/_t{i} suffix layout.
+        metrics = split_scenario_metrics(jax.device_get(m))
         dt = time.time() - t0
         metrics["env_steps_per_sec"] = (
             config.steps_per_epoch * loop.n_envs * loop.n_dp / dt
@@ -1022,8 +1076,8 @@ def train_population_on_device(
         split_member_metrics,
     )
     from torch_actor_critic_tpu.envs.ondevice import (
-        ON_DEVICE_ENVS,
         get_on_device_env,
+        known_on_device_envs,
     )
     from torch_actor_critic_tpu.parallel.distributed import is_coordinator
 
@@ -1060,7 +1114,7 @@ def train_population_on_device(
     if env_cls is None:
         raise ValueError(
             f"{env_name!r} has no pure-JAX twin; on-device training "
-            f"supports {sorted(ON_DEVICE_ENVS)}"
+            f"supports {known_on_device_envs()}"
         )
     env_cls, sac = _wrap_and_build(env_cls, config)
     loop = PopulationOnDeviceLoop(
@@ -1235,10 +1289,21 @@ def benchmark_on_device(
         "pendulum": "Pendulum-v1",
         "cheetah": "cheetah-run-jax",
         "pixel": "PixelPendulum-v0",
+        # The scenarios/ families (bench.py `scenarios` stage).
+        "multiagent": "multi-pendulum-4",
+        "procedural": "hurdle-runner",
+        "multitask": "pendulum-multitask",
     }
     env_cls = get_on_device_env(aliases.get(env_name, env_name))
     if env_cls is None:
-        raise ValueError(f"no on-device twin for {env_name!r}")
+        from torch_actor_critic_tpu.envs.ondevice import (
+            known_on_device_envs,
+        )
+
+        raise ValueError(
+            f"no on-device twin for {env_name!r}; known envs: "
+            f"{known_on_device_envs()}"
+        )
     if hasattr(env_cls, "obs_spec"):
         # Pixel twin: the shared recipe's conv geometry (augmentation
         # irrelevant here — the bench times bursts, not learning).
@@ -1251,7 +1316,7 @@ def benchmark_on_device(
             hidden_sizes=(256, 256), batch_size=64, history_len=history_len
         )
     env_cls, sac = _wrap_and_build(env_cls, cfg)
-    loop = OnDeviceLoop(sac, env_cls, n_envs=n_envs)
+    loop = loop_class_for(env_cls)(sac, env_cls, n_envs=n_envs)
     ts, buf, es, key = loop.init(jax.random.key(0), buffer_capacity=200_000)
     ts, buf, es, key, _ = loop.epoch(
         ts, buf, es, key, steps=update_every, update_every=update_every,

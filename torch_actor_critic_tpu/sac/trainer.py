@@ -109,6 +109,81 @@ def build_models(config: SACConfig, env) -> t.Tuple[t.Any, t.Any]:
             "pixel_pipeline='fused' requires a visual (frame) "
             f"observation; got obs spec {env.obs_spec}"
         )
+    # Scenario model dispatch (scenarios/, docs/SCENARIOS.md): the env
+    # class advertises its multi-agent factorization / task count and
+    # the heads follow. SAC-only and flat-observation-only — fail at
+    # construction, same policy as the augment/pixel gates above.
+    n_agents = getattr(env, "n_agents", 1)
+    n_tasks = getattr(env, "n_tasks", 0)
+    if n_agents > 1 or (n_tasks > 1 and config.task_embed_dim > 0):
+        if config.algorithm != "sac":
+            raise ValueError(
+                "multi-agent / task-embedding heads are SAC-only; got "
+                f"algorithm={config.algorithm!r}"
+            )
+        if isinstance(env.obs_spec, MultiObservation) or len(
+            env.obs_spec.shape
+        ) != 1:
+            raise ValueError(
+                "multi-agent / task-embedding heads need flat "
+                f"observations; got obs spec {env.obs_spec} (drop "
+                "history_len or use the plain one-hot conditioning)"
+            )
+    if n_agents > 1:
+        from torch_actor_critic_tpu.models import (
+            MultiAgentActor,
+            MultiAgentDoubleCritic,
+        )
+
+        actor = MultiAgentActor(
+            n_agents=n_agents,
+            agent_obs_dim=env.agent_obs_dim,
+            act_dim=env.act_dim,
+            hidden_sizes=config.hidden_sizes,
+            act_limit=env.act_limit,
+            dtype=dtype,
+        )
+        if config.ma_critic == "centralized":
+            # CTDE: the joint-(obs, action) twin critic IS the plain
+            # DoubleCritic — centralized training, decentralized
+            # per-agent actor heads.
+            critic = DoubleCritic(
+                hidden_sizes=config.hidden_sizes,
+                num_qs=config.num_qs,
+                dtype=dtype,
+            )
+        else:
+            critic = MultiAgentDoubleCritic(
+                n_agents=n_agents,
+                agent_obs_dim=env.agent_obs_dim,
+                agent_act_dim=env.act_dim // n_agents,
+                hidden_sizes=config.hidden_sizes,
+                num_qs=config.num_qs,
+                dtype=dtype,
+            )
+        return actor, critic
+    if n_tasks > 1 and config.task_embed_dim > 0:
+        from torch_actor_critic_tpu.models import (
+            TaskConditionedActor,
+            TaskConditionedDoubleCritic,
+        )
+
+        actor = TaskConditionedActor(
+            n_tasks=n_tasks,
+            task_embed_dim=config.task_embed_dim,
+            act_dim=env.act_dim,
+            hidden_sizes=config.hidden_sizes,
+            act_limit=env.act_limit,
+            dtype=dtype,
+        )
+        critic = TaskConditionedDoubleCritic(
+            n_tasks=n_tasks,
+            task_embed_dim=config.task_embed_dim,
+            hidden_sizes=config.hidden_sizes,
+            num_qs=config.num_qs,
+            dtype=dtype,
+        )
+        return actor, critic
     if config.algorithm == "td3":
         # TD3 (extension): deterministic tanh policy over the flat MLP
         # or visual stack (same twin critics as SAC). The sequence

@@ -1,0 +1,94 @@
+"""scenarios/ — multi-agent, procedural, and multi-task workloads.
+
+ROADMAP item 3 ("Scenario diversity") built: the training machinery
+(fused epochs, population vmap, GSPMD sharding, fleet serving) had
+outgrown the three classic single-agent env families; this package
+grows the workload side to match — JaxMARL/Octax-style (PAPERS.md)
+pure-``jnp`` env suites that fuse into the existing on-device epoch
+program. Three pillars:
+
+- :mod:`~torch_actor_critic_tpu.scenarios.multiagent` — N agents in
+  one shared physics state (coupled pendulum ring), per-agent heads
+  via the population ``nn.vmap`` machinery, CTDE centralized (or VDN
+  per-agent) twin critics, per-agent metrics;
+- :mod:`~torch_actor_critic_tpu.scenarios.procedural` — a
+  procedurally-generated hurdle-runner whose level is drawn from the
+  env PRNG stream at every (auto-)reset: no two episodes alike, zero
+  host involvement;
+- :mod:`~torch_actor_critic_tpu.scenarios.multitask` — one
+  task-conditioned policy over a task family, per-task replay
+  striping (``buffer/striped.py``), per-task ``_t{i}`` metrics, and
+  per-task serving slots (``scenarios/serving.py``) on the multi-slot
+  registry — one fleet, many workloads.
+
+The registry below is the scenario counterpart of
+``envs/ondevice.py``'s ``ON_DEVICE_ENVS``;
+``envs.ondevice.get_on_device_env`` consults BOTH, so every on-device
+entry point (train CLI, population, bench, smoke) accepts scenario
+names transparently. See docs/SCENARIOS.md.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+from torch_actor_critic_tpu.scenarios.multiagent import multi_agent_pendulum
+from torch_actor_critic_tpu.scenarios.multitask import PendulumMultiTaskJax
+from torch_actor_critic_tpu.scenarios.procedural import HurdleRunnerJax
+
+__all__ = [
+    "HurdleRunnerJax",
+    "PendulumMultiTaskJax",
+    "SCENARIO_ENVS",
+    "get_scenario",
+    "multi_agent_pendulum",
+    "register_scenario",
+    "scenario_names",
+]
+
+# name -> on-device env class (the EnvState/StepOut protocol of
+# envs/ondevice.py). Mutated only through register_scenario.
+SCENARIO_ENVS: t.Dict[str, type] = {}
+
+
+def register_scenario(name: str, env_cls: type, replace: bool = False):
+    """Add a scenario env class to the registry. Collisions with an
+    existing scenario OR a classic on-device env name raise unless
+    ``replace=True`` — a silent shadow would reroute every entry point
+    that resolves the name."""
+    from torch_actor_critic_tpu.envs.ondevice import ON_DEVICE_ENVS
+
+    if not replace and (name in SCENARIO_ENVS or name in ON_DEVICE_ENVS):
+        raise ValueError(
+            f"scenario name {name!r} is already registered; pass "
+            "replace=True to shadow it"
+        )
+    SCENARIO_ENVS[name] = env_cls
+    return env_cls
+
+
+def scenario_names() -> t.List[str]:
+    return sorted(SCENARIO_ENVS)
+
+
+def get_scenario(name: str) -> type:
+    """Strict lookup: unknown names raise with the full registered
+    list (never a bare KeyError)."""
+    env_cls = SCENARIO_ENVS.get(name)
+    if env_cls is None:
+        from torch_actor_critic_tpu.envs.ondevice import known_on_device_envs
+
+        raise ValueError(
+            f"unknown scenario {name!r}; registered scenarios: "
+            f"{scenario_names()} (all on-device envs: "
+            f"{known_on_device_envs()})"
+        )
+    return env_cls
+
+
+# ------------------------------------------------------------ built-ins
+
+register_scenario("multi-pendulum-2", multi_agent_pendulum(2))
+register_scenario("multi-pendulum-4", multi_agent_pendulum(4))
+register_scenario("hurdle-runner", HurdleRunnerJax)
+register_scenario("pendulum-multitask", PendulumMultiTaskJax)

@@ -1,0 +1,158 @@
+"""Multi-agent on-device scenario: N agents in ONE shared physics state.
+
+JaxMARL-style (arXiv:2311.10090) task expressed in the pure-``jnp``
+:class:`~torch_actor_critic_tpu.envs.ondevice.EnvState` protocol, so it
+fuses into the existing epoch program unchanged: a **ring of N
+pendulums coupled by torsional springs** between neighbours. Each agent
+torques its own rod but feels its neighbours through the coupling, so
+no agent can solve its swing-up alone once the springs are stiff —
+the cooperative structure the per-agent metrics make visible.
+
+Interface contract with the rest of the stack:
+
+- The *joint* observation/action are flat vectors (``obs_dim =
+  n_agents * agent_obs_dim``, ``act_dim = n_agents``): the fused loop,
+  replay ring and serving plane see an ordinary flat env.
+- The per-agent factorization lives in the class attributes
+  (``n_agents``, ``agent_obs_dim``): ``build_models`` dispatches on
+  them to the per-agent heads (``models/multiagent.py`` — the PR-6
+  population ``nn.vmap`` machinery over the agent axis) with a
+  CTDE-style centralized twin critic by default.
+- Per-agent episode returns accumulate in the physics state and are
+  reported through ``StepOut.extras['return_per_agent']`` — the
+  scenario loop reduces them into ``reward_per_agent`` metrics (host
+  layout ``reward_a{i}``, the ``_m{i}`` member convention applied to
+  agents).
+
+Per-agent observation (7 dims): own ``(cos, sin, theta_dot)`` plus the
+left and right neighbours' ``(cos, sin)`` — enough to coordinate, local
+enough that the task is genuinely decentralized-execution.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torch_actor_critic_tpu.envs.ondevice import EnvState, PendulumJax, StepOut
+
+
+def multi_agent_pendulum(n_agents: int, max_episode_steps: int = 200):
+    """Build the N-agent coupled-pendulum-ring scenario class."""
+    if n_agents < 2:
+        raise ValueError(
+            f"multi_agent_pendulum needs >= 2 agents, got {n_agents}"
+        )
+    n = int(n_agents)
+    steps_limit = int(max_episode_steps)
+
+    class MultiPendulumJax:
+        n_agents = n
+        agent_obs_dim = 7
+        obs_dim = n * 7
+        act_dim = n  # one torque per agent
+        act_limit = PendulumJax.act_limit
+        max_episode_steps = steps_limit
+
+        max_speed = PendulumJax.max_speed
+        dt = PendulumJax.dt
+        g = PendulumJax.g
+        m = PendulumJax.m
+        length = PendulumJax.length
+        coupling = 2.0  # torsional spring stiffness between neighbours
+
+        @classmethod
+        def _obs(cls, theta, theta_dot):
+            left = jnp.roll(theta, 1)
+            right = jnp.roll(theta, -1)
+            per_agent = jnp.stack(
+                [
+                    jnp.cos(theta), jnp.sin(theta), theta_dot,
+                    jnp.cos(left), jnp.sin(left),
+                    jnp.cos(right), jnp.sin(right),
+                ],
+                axis=-1,
+            )  # (n_agents, 7)
+            return per_agent.reshape(cls.obs_dim)
+
+        @classmethod
+        def reset(cls, key: jax.Array) -> EnvState:
+            k_theta, k_vel, k_next = jax.random.split(key, 3)
+            theta = jax.random.uniform(
+                k_theta, (cls.n_agents,), minval=-jnp.pi, maxval=jnp.pi
+            )
+            theta_dot = jax.random.uniform(
+                k_vel, (cls.n_agents,), minval=-1.0, maxval=1.0
+            )
+            return EnvState(
+                inner=(theta, theta_dot, jnp.zeros(cls.n_agents)),
+                obs=cls._obs(theta, theta_dot),
+                step_count=jnp.int32(0),
+                episode_return=jnp.float32(0.0),
+                rng=k_next,
+            )
+
+        @classmethod
+        def step(cls, state: EnvState, action: jax.Array):
+            theta, theta_dot, agent_return = state.inner
+            u = jnp.clip(action, -cls.act_limit, cls.act_limit)
+            angle = ((theta + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+            # Per-agent swing-up reward (the Pendulum-v1 shaping, per
+            # rod); the TEAM reward the learner optimizes is the mean,
+            # so every agent shares credit — cooperative MARL.
+            per_agent_reward = -(
+                angle**2 + 0.1 * theta_dot**2 + 0.001 * u**2
+            )
+            reward = jnp.mean(per_agent_reward)
+
+            # Shared physics: each rod is a PendulumJax rod plus the
+            # neighbour springs (ring topology — roll has no ends).
+            spring = cls.coupling * (
+                jnp.roll(theta, 1) + jnp.roll(theta, -1) - 2.0 * theta
+            )
+            theta_dot = theta_dot + cls.dt * (
+                3.0 * cls.g / (2.0 * cls.length) * jnp.sin(theta)
+                + 3.0 / (cls.m * cls.length**2) * u
+                + spring
+            )
+            theta_dot = jnp.clip(theta_dot, -cls.max_speed, cls.max_speed)
+            theta = theta + cls.dt * theta_dot
+
+            step_count = state.step_count + 1
+            ended = step_count >= cls.max_episode_steps  # truncation only
+
+            stepped = EnvState(
+                inner=(
+                    theta,
+                    theta_dot,
+                    agent_return + per_agent_reward,
+                ),
+                obs=cls._obs(theta, theta_dot),
+                step_count=step_count,
+                episode_return=state.episode_return + reward,
+                rng=state.rng,
+            )
+            fresh = cls.reset(state.rng)
+            next_state = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(ended, a, b), fresh, stepped
+            )
+            ended_f = ended.astype(jnp.float32)
+            out = StepOut(
+                next_obs=stepped.obs,
+                reward=reward,
+                terminated=jnp.float32(0.0),  # never terminates
+                ended=ended,
+                final_return=stepped.episode_return,
+                extras={
+                    # Per-agent episode returns, reported once per
+                    # finished episode (zero rows otherwise) — the
+                    # scenario loop divides the epoch sum by the epoch
+                    # episode count for per-agent mean returns.
+                    "return_per_agent": ended_f * stepped.inner[2],
+                },
+            )
+            return next_state, out
+
+    MultiPendulumJax.__name__ = f"MultiPendulum{n}Jax"
+    MultiPendulumJax.__qualname__ = MultiPendulumJax.__name__
+    return MultiPendulumJax

@@ -1,0 +1,159 @@
+"""Multi-task on-device scenario: one policy, several tasks at once.
+
+The third scenarios/ pillar: a task family over ONE shared physics
+(the pendulum) where each vectorized env slot draws a task id at its
+first reset and keeps it across auto-resets, so a fixed share of the
+collected experience belongs to every task for the whole run:
+
+- ``swingup`` — the classic full-circle swing-up (Pendulum-v1 reward);
+- ``balance`` — starts near upright, sharper angle penalty: pure
+  stabilization;
+- ``spin`` — reward peaks at a target angular speed: the policy must
+  *rotate*, the opposite of balance.
+
+Task conditioning: the task one-hot is the TRAILING ``n_tasks`` dims
+of the flat observation (``base_obs_dim`` + ``n_tasks``). That single
+convention drives everything downstream:
+
+- the policy/critics are task-conditioned by construction (the one-hot
+  is just part of obs; ``task_embed_dim > 0`` swaps in the learned
+  task-embedding heads, ``models/taskembed.py``);
+- the striped replay ring (``buffer/striped.py``) recovers each
+  transition's task from the one-hot and keeps one ring stripe per
+  task, so replay sampling stays balanced even when exploration
+  collapses onto one task's envs;
+- per-task metrics (``episodes_per_task``/``reward_per_task`` →
+  ``reward_t{i}`` host keys) come from ``StepOut.extras`` one-hot
+  masks, the suffix-keyed member convention applied to tasks;
+- serving exports one slot per task by pinning the one-hot
+  (``scenarios/serving.py``) — one fleet, many workloads.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torch_actor_critic_tpu.envs.ondevice import EnvState, PendulumJax, StepOut
+
+
+class PendulumMultiTaskJax:
+    """Three pendulum tasks behind one task-conditioned observation."""
+
+    task_names = ("swingup", "balance", "spin")
+    n_tasks = 3
+    base_obs_dim = 3
+    obs_dim = 3 + 3  # base obs + task one-hot
+    act_dim = 1
+    act_limit = PendulumJax.act_limit
+    max_episode_steps = 200
+
+    max_speed = PendulumJax.max_speed
+    dt = PendulumJax.dt
+    g = PendulumJax.g
+    m = PendulumJax.m
+    length = PendulumJax.length
+    spin_target = 5.0  # |theta_dot| the spin task rewards
+
+    @classmethod
+    def _obs(cls, theta, theta_dot, task):
+        return jnp.concatenate([
+            jnp.stack([jnp.cos(theta), jnp.sin(theta), theta_dot]),
+            jax.nn.one_hot(task, cls.n_tasks),
+        ])
+
+    @classmethod
+    def _sample_pose(cls, key: jax.Array, task: jax.Array):
+        """Task-conditioned initial pose: balance starts near upright
+        (stabilization is only learnable from there within an episode);
+        the other tasks use the full-circle Pendulum-v1 draw."""
+        k_theta, k_vel = jax.random.split(key)
+        full = jax.random.uniform(k_theta, (), minval=-jnp.pi, maxval=jnp.pi)
+        near = jax.random.uniform(
+            k_theta, (), minval=-0.15 * jnp.pi, maxval=0.15 * jnp.pi
+        )
+        theta = jnp.where(task == 1, near, full)
+        slow = jax.random.uniform(k_vel, (), minval=-0.2, maxval=0.2)
+        fast = jax.random.uniform(k_vel, (), minval=-1.0, maxval=1.0)
+        theta_dot = jnp.where(task == 1, slow, fast)
+        return theta, theta_dot
+
+    @classmethod
+    def _reward(cls, task, angle, theta_dot, u):
+        r_swing = -(angle**2 + 0.1 * theta_dot**2 + 0.001 * u**2)
+        r_balance = -(4.0 * angle**2 + 0.2 * theta_dot**2 + 0.001 * u**2)
+        r_spin = -(
+            0.2 * (jnp.abs(theta_dot) - cls.spin_target) ** 2 + 0.001 * u**2
+        )
+        return jnp.where(
+            task == 0, r_swing, jnp.where(task == 1, r_balance, r_spin)
+        )
+
+    @classmethod
+    def reset(cls, key: jax.Array) -> EnvState:
+        k_task, k_pose, k_next = jax.random.split(key, 3)
+        task = jax.random.randint(k_task, (), 0, cls.n_tasks)
+        theta, theta_dot = cls._sample_pose(k_pose, task)
+        return EnvState(
+            inner=(task, theta, theta_dot),
+            obs=cls._obs(theta, theta_dot, task),
+            step_count=jnp.int32(0),
+            episode_return=jnp.float32(0.0),
+            rng=k_next,
+        )
+
+    @classmethod
+    def step(cls, state: EnvState, action: jax.Array):
+        task, theta, theta_dot = state.inner
+        u = jnp.clip(action[..., 0], -cls.act_limit, cls.act_limit)
+        angle = ((theta + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        reward = cls._reward(task, angle, theta_dot, u)
+
+        theta_dot = theta_dot + cls.dt * (
+            3.0 * cls.g / (2.0 * cls.length) * jnp.sin(theta)
+            + 3.0 / (cls.m * cls.length**2) * u
+        )
+        theta_dot = jnp.clip(theta_dot, -cls.max_speed, cls.max_speed)
+        theta = theta + cls.dt * theta_dot
+
+        step_count = state.step_count + 1
+        ended = step_count >= cls.max_episode_steps  # truncation only
+
+        stepped = EnvState(
+            inner=(task, theta, theta_dot),
+            obs=cls._obs(theta, theta_dot, task),
+            step_count=step_count,
+            episode_return=state.episode_return + reward,
+            rng=state.rng,
+        )
+        # Auto-reset keeps the env slot's TASK (a fresh pose only): the
+        # per-env task assignment is what keeps the replay stripes and
+        # per-task curves fed for the whole run.
+        k_pose, k_next = jax.random.split(state.rng)
+        f_theta, f_theta_dot = cls._sample_pose(k_pose, task)
+        fresh = EnvState(
+            inner=(task, f_theta, f_theta_dot),
+            obs=cls._obs(f_theta, f_theta_dot, task),
+            step_count=jnp.int32(0),
+            episode_return=jnp.float32(0.0),
+            rng=k_next,
+        )
+        next_state = jax.tree_util.tree_map(
+            lambda p, q: jnp.where(ended, p, q), fresh, stepped
+        )
+        onehot = jax.nn.one_hot(task, cls.n_tasks)
+        ended_f = ended.astype(jnp.float32)
+        out = StepOut(
+            next_obs=stepped.obs,
+            reward=reward,
+            terminated=jnp.float32(0.0),  # never terminates
+            ended=ended,
+            final_return=stepped.episode_return,
+            extras={
+                "episodes_per_task": ended_f * onehot,
+                "return_per_task": (
+                    ended_f * stepped.episode_return * onehot
+                ),
+            },
+        )
+        return next_state, out

@@ -1,0 +1,192 @@
+"""Procedurally-generated on-device scenario: no two episodes alike.
+
+Octax-style (arXiv:2510.01764) in-graph level generation applied to a
+wall-runner analogue: a planar runner that must hold a target speed
+over procedurally-generated terrain while clearing hurdles. The entire
+level — hurdle layout, hurdle heights, target speed, terrain profile —
+is drawn from the env's own PRNG stream at (auto-)reset and carried in
+``EnvState.inner``, so every episode trains on a fresh level with ZERO
+host involvement: generation is just a few ``jax.random`` draws inside
+the already-compiled reset, and the auto-reset path (``state.rng``)
+regenerates mid-epoch exactly like the classic envs re-draw a pose.
+
+Dynamics (pure jnp, honest but simple): a point-mass runner with
+horizontal thrust and a ground-gated jump impulse over sinusoidal
+terrain. A hurdle is cleared by being airborne above its height when
+crossing it; hitting one zeroes forward velocity and costs reward, so
+the learnable skill is pacing + timed jumps — and because the hurdle
+spacing/heights change every episode, the policy must read the level
+from the observation (relative distances + heights of the next three
+hurdles) rather than memorize a track.
+
+The level is observable via :meth:`HurdleRunnerJax.level_params` (the
+test hook pinning per-episode variation) and survives the history
+adapter unchanged (the base ``EnvState`` rides in ``inner``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torch_actor_critic_tpu.envs.ondevice import EnvState, StepOut
+
+
+class HurdleRunnerJax:
+    """Procedural hurdle-runner; level re-drawn from the PRNG stream at
+    every (auto-)reset."""
+
+    obs_dim = 11
+    act_dim = 2  # (horizontal thrust, jump)
+    act_limit = 1.0
+    max_episode_steps = 300
+
+    n_hurdles = 8
+    dt = 0.05
+    gravity = 9.8
+    thrust_gain = 6.0
+    jump_gain = 5.0
+    drag = 0.4
+    hurdle_halfwidth = 0.4
+
+    # ------------------------------------------------------------ level
+
+    @classmethod
+    def _level(cls, key: jax.Array):
+        """One level draw: ``(hurdle_x, hurdle_h, target_speed, amp,
+        freq, phase)`` — the tuple that rides ``EnvState.inner``."""
+        k_gap, k_h, k_speed, k_amp, k_freq, k_phase = jax.random.split(
+            key, 6
+        )
+        gaps = jax.random.uniform(
+            k_gap, (cls.n_hurdles,), minval=4.0, maxval=10.0
+        )
+        hurdle_x = 5.0 + jnp.cumsum(gaps)
+        hurdle_h = jax.random.uniform(
+            k_h, (cls.n_hurdles,), minval=0.2, maxval=0.8
+        )
+        target_speed = jax.random.uniform(k_speed, (), minval=1.0, maxval=3.0)
+        amp = jax.random.uniform(k_amp, (), minval=0.0, maxval=0.3)
+        freq = jax.random.uniform(k_freq, (), minval=0.3, maxval=1.0)
+        phase = jax.random.uniform(k_phase, (), minval=0.0, maxval=2 * jnp.pi)
+        return (hurdle_x, hurdle_h, target_speed, amp, freq, phase)
+
+    @staticmethod
+    def level_params(state: EnvState) -> dict:
+        """The current episode's level as a dict — the introspection
+        hook the per-episode-variation tests pin against."""
+        hurdle_x, hurdle_h, target_speed, amp, freq, phase = state.inner[4]
+        return {
+            "hurdle_x": hurdle_x,
+            "hurdle_h": hurdle_h,
+            "target_speed": target_speed,
+            "amp": amp,
+            "freq": freq,
+            "phase": phase,
+        }
+
+    @staticmethod
+    def _ground(level, x):
+        _, _, _, amp, freq, phase = level
+        return amp * jnp.sin(freq * x + phase)
+
+    # -------------------------------------------------------------- obs
+
+    @classmethod
+    def _obs(cls, x, y, vx, vy, level):
+        hurdle_x, hurdle_h, target_speed, amp, freq, phase = level
+        ground = cls._ground(level, x)
+        slope = amp * freq * jnp.cos(freq * x + phase)
+        # Next three hurdles ahead: relative distance (normalized) +
+        # height. Passed hurdles sort to the back via the large fill.
+        rel = hurdle_x - x
+        dist = jnp.where(rel > 0.0, rel, 1e9)
+        order = jnp.argsort(dist)
+        d3 = jnp.clip(dist[order[:3]], 0.0, 20.0) / 20.0
+        h3 = hurdle_h[order[:3]]
+        return jnp.concatenate([
+            jnp.stack([
+                vx / 5.0, vy / 5.0, y - ground, slope, target_speed / 3.0,
+            ]),
+            d3,
+            h3,
+        ])
+
+    # ----------------------------------------------------------- protocol
+
+    @classmethod
+    def reset(cls, key: jax.Array) -> EnvState:
+        k_level, k_vel, k_next = jax.random.split(key, 3)
+        level = cls._level(k_level)
+        x = jnp.float32(0.0)
+        y = cls._ground(level, x)
+        vx = jax.random.uniform(k_vel, (), minval=0.0, maxval=0.5)
+        vy = jnp.float32(0.0)
+        return EnvState(
+            inner=(x, y, vx, vy, level),
+            obs=cls._obs(x, y, vx, vy, level),
+            step_count=jnp.int32(0),
+            episode_return=jnp.float32(0.0),
+            rng=k_next,
+        )
+
+    @classmethod
+    def step(cls, state: EnvState, action: jax.Array):
+        x, y, vx, vy, level = state.inner
+        hurdle_x, hurdle_h, target_speed, _, _, _ = level
+        a = jnp.clip(action, -cls.act_limit, cls.act_limit)
+
+        ground = cls._ground(level, x)
+        on_ground = (y - ground) <= 1e-3
+        # Jump is an impulse, available only from the ground (airborne
+        # thrust would make hurdles trivially avoidable).
+        vy = vy - cls.dt * cls.gravity + jnp.where(
+            on_ground & (a[1] > 0.0), cls.jump_gain * a[1], 0.0
+        )
+        vx = vx + cls.dt * (cls.thrust_gain * a[0] - cls.drag * vx)
+        x = x + cls.dt * vx
+        y = y + cls.dt * vy
+
+        new_ground = cls._ground(level, x)
+        landed = y <= new_ground
+        y = jnp.maximum(y, new_ground)
+        vy = jnp.where(landed, jnp.maximum(vy, 0.0), vy)
+
+        # Hurdle collision: inside a hurdle's footprint below its top.
+        hit = jnp.any(
+            (jnp.abs(x - hurdle_x) < cls.hurdle_halfwidth)
+            & ((y - new_ground) < hurdle_h)
+        )
+        vx = jnp.where(hit, jnp.float32(0.0), vx)
+
+        reward = (
+            1.0
+            - jnp.abs(vx - target_speed) / target_speed
+            - 1.0 * hit.astype(jnp.float32)
+            - 0.01 * jnp.sum(a**2)
+        )
+
+        step_count = state.step_count + 1
+        ended = step_count >= cls.max_episode_steps  # truncation only
+
+        stepped = EnvState(
+            inner=(x, y, vx, vy, level),
+            obs=cls._obs(x, y, vx, vy, level),
+            step_count=step_count,
+            episode_return=state.episode_return + reward,
+            rng=state.rng,
+        )
+        # Auto-reset draws a FRESH level off the env's own PRNG stream
+        # — the procedural property: no two episodes share a level.
+        fresh = cls.reset(state.rng)
+        next_state = jax.tree_util.tree_map(
+            lambda p, q: jnp.where(ended, p, q), fresh, stepped
+        )
+        out = StepOut(
+            next_obs=stepped.obs,
+            reward=reward,
+            terminated=jnp.float32(0.0),  # never terminates
+            ended=ended,
+            final_return=stepped.episode_return,
+        )
+        return next_state, out

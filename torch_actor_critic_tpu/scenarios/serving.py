@@ -1,0 +1,132 @@
+"""Scenario serving: trained workloads as slots on the fleet registry.
+
+"One fleet, many workloads": the multi-slot
+:class:`~torch_actor_critic_tpu.serve.registry.ModelRegistry` already
+serves N independent models from one process (and the PR-9 fleet
+router scales that across workers). This module maps trained scenarios
+onto that surface:
+
+- a **multi-task** policy exports ONE SLOT PER TASK:
+  :class:`TaskSlotPolicy` pins a task id by appending its one-hot to
+  the client's *base* observation inside the compiled forward, so each
+  slot presents the plain per-task interface (clients of the
+  ``balance`` slot send 3-dim pendulum observations and never know the
+  model is task-conditioned). All slots share the same params pytree —
+  hot-reloading the training run's checkpoint advances every task slot
+  together, one restore per generation.
+- **multi-agent** and **procedural** policies export as one slot each
+  over their joint/flat observation (nothing to split).
+
+The adapter honors the actor contract
+(``apply(params, obs, key, deterministic, with_logprob)``), so the
+bucketed jit cache, micro-batcher, breakers and hot-reload validation
+apply to scenario slots exactly as to any other.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+
+
+class TaskSlotPolicy:
+    """Actor-contract adapter pinning one task of a task-conditioned
+    policy: accepts the task's BASE observation and appends the fixed
+    task one-hot before the wrapped actor's forward."""
+
+    def __init__(self, actor_def, n_tasks: int, task_id: int):
+        if not 0 <= task_id < n_tasks:
+            raise ValueError(
+                f"task_id {task_id} outside [0, {n_tasks})"
+            )
+        self.actor_def = actor_def
+        self.n_tasks = int(n_tasks)
+        self.task_id = int(task_id)
+        # Engine/batcher introspection (act_limit rides through).
+        self.act_limit = getattr(actor_def, "act_limit", 1.0)
+
+    def apply(
+        self,
+        params,
+        obs: jax.Array,
+        key=None,
+        deterministic: bool = False,
+        with_logprob: bool = True,
+    ):
+        onehot = jnp.zeros(
+            obs.shape[:-1] + (self.n_tasks,), obs.dtype
+        ).at[..., self.task_id].set(1.0)
+        return self.actor_def.apply(
+            params,
+            jnp.concatenate([obs, onehot], axis=-1),
+            key,
+            deterministic=deterministic,
+            with_logprob=with_logprob,
+        )
+
+
+def scenario_slot_names(env_cls, name: str) -> t.List[str]:
+    """The slot names a scenario env exports: ``{name}/{task}`` per
+    task for multi-task envs, ``[name]`` otherwise."""
+    n_tasks = getattr(env_cls, "n_tasks", 0)
+    if n_tasks > 1:
+        task_names = getattr(
+            env_cls, "task_names", tuple(f"t{i}" for i in range(n_tasks))
+        )
+        return [f"{name}/{task_names[i]}" for i in range(n_tasks)]
+    return [name]
+
+
+def register_scenario_slots(
+    registry,
+    env_cls,
+    actor_def,
+    name: str = "scenario",
+    params=None,
+    ckpt_dir: str | None = None,
+    max_batch: int = 64,
+    warmup: bool = True,
+    replace: bool = False,
+) -> t.List[str]:
+    """Register a trained scenario on the multi-slot registry.
+
+    Multi-task envs get one slot per task (``{name}/{task}``, each a
+    :class:`TaskSlotPolicy` over the task's base observation); other
+    scenarios get one slot over their flat observation. ``params`` /
+    ``ckpt_dir`` follow :meth:`ModelRegistry.register` (exactly one;
+    ``ckpt_dir`` arms the validated hot-reload, which advances every
+    task slot of the same run together). Returns the slot names.
+    """
+    n_tasks = getattr(env_cls, "n_tasks", 0)
+    names = scenario_slot_names(env_cls, name)
+    if n_tasks > 1:
+        base_dim = env_cls.obs_dim - n_tasks
+        obs_spec = jax.ShapeDtypeStruct((base_dim,), jnp.float32)
+        for task_id, slot in enumerate(names):
+            registry.register(
+                slot,
+                TaskSlotPolicy(actor_def, n_tasks, task_id),
+                obs_spec,
+                params=params,
+                ckpt_dir=ckpt_dir,
+                max_batch=max_batch,
+                warmup=warmup,
+                replace=replace,
+            )
+        return names
+    obs_spec = jax.ShapeDtypeStruct(
+        getattr(env_cls, "obs_shape", (env_cls.obs_dim,)), jnp.float32
+    )
+    registry.register(
+        names[0],
+        actor_def,
+        obs_spec,
+        params=params,
+        ckpt_dir=ckpt_dir,
+        max_batch=max_batch,
+        warmup=warmup,
+        replace=replace,
+    )
+    return names

@@ -247,6 +247,31 @@ def main(argv=None):
         )
 
     if config.on_device:
+        # Scenario workloads (scenarios/, docs/SCENARIOS.md) resolve
+        # through the same on-device registry; announce their structure
+        # so a run's log states which metric layout (reward_a{i} /
+        # reward_t{i}) and replay layout (striped) to expect.
+        from torch_actor_critic_tpu.envs.ondevice import get_on_device_env
+
+        scenario_cls = get_on_device_env(env_name)
+        if scenario_cls is not None:
+            n_agents = getattr(scenario_cls, "n_agents", 1)
+            n_tasks = getattr(scenario_cls, "n_tasks", 0)
+            if n_agents > 1:
+                logger.info(
+                    "scenario workload %s: %d agents in one shared "
+                    "physics state (%s critic; per-agent reward_a{i} "
+                    "metrics)",
+                    env_name, n_agents, config.ma_critic,
+                )
+            if n_tasks > 1:
+                logger.info(
+                    "scenario workload %s: %d tasks (%s conditioning; "
+                    "per-task striped replay; reward_t{i} metrics)",
+                    env_name, n_tasks,
+                    f"embed[{config.task_embed_dim}]"
+                    if config.task_embed_dim > 0 else "one-hot",
+                )
         if config.diagnostics != "off":
             logger.warning(
                 "--diagnostics is a host-Trainer feature; the fused "

@@ -148,6 +148,19 @@ class SACConfig:
     pbt_perturb: float = 1.25   # multiplicative explore factor (>1)
     pbt_ema: float = 0.5        # EMA weight of each new epoch's mean return
 
+    # --- scenarios/ (multi-agent / procedural / multi-task on-device
+    # workloads, docs/SCENARIOS.md) ---
+    # Multi-agent critic mode: "centralized" (CTDE — one twin critic
+    # over the joint observation/action; the default) or "per_agent"
+    # (VDN-style per-agent twin critics summed into the joint Q).
+    # Ignored for envs without a multi-agent structure.
+    ma_critic: str = "centralized"
+    # Multi-task conditioning: 0 (default) feeds the task one-hot to
+    # the policy/critics as ordinary observation features; > 0 projects
+    # it through a learned linear embedding of this width first
+    # (models/taskembed.py). Ignored for single-task envs.
+    task_embed_dim: int = 0
+
     # Observation normalization (the reference ships a Welford
     # normalizer as dead code, ref sac/utils.py:27-65; here it's a
     # usable option).
@@ -372,6 +385,16 @@ class SACConfig:
         if not 0.0 < self.pbt_ema <= 1.0:
             raise ValueError(
                 f"pbt_ema must be in (0, 1], got {self.pbt_ema}"
+            )
+        if self.ma_critic not in ("centralized", "per_agent"):
+            raise ValueError(
+                f"ma_critic must be 'centralized' or 'per_agent', got "
+                f"{self.ma_critic!r}"
+            )
+        if self.task_embed_dim < 0:
+            raise ValueError(
+                f"task_embed_dim must be >= 0 (0 = raw one-hot), got "
+                f"{self.task_embed_dim}"
             )
         if self.diagnostics not in ("off", "light", "full"):
             raise ValueError(
