@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke decouple-smoke visual-smoke scenario-smoke
+.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke shard-serve-smoke decouple-smoke visual-smoke scenario-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -114,6 +114,16 @@ chaos-smoke:
 # graceful SIGTERM teardown (docs/SERVING.md "Fleet").
 fleet-smoke:
 	JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+
+# Sharded-serving smoke: real `serve.py --devices all --submesh 2x2
+# --fleet 2` under the forced 8-device CPU shim — each worker carves
+# its devices into two (2,2) GSPMD sub-mesh replicas; flood the
+# router, mid-flood validated hot-reload (one sharded transfer per
+# replica, asserted via the transfer-bytes counter) and SIGKILL one
+# worker: zero accepted drops, graceful SIGTERM teardown
+# (docs/SERVING.md "Sharded serving & precision tiers").
+shard-serve-smoke:
+	JAX_PLATFORMS=cpu python scripts/shard_serve_smoke.py
 
 # Decoupled actor/learner chaos: (1) in-process bitwise proof — SIGTERM
 # mid-epoch with a staged-transition tail, resume is bitwise on learner

@@ -1829,6 +1829,136 @@ def bench_fleet(budget_s=300.0, service_ms=8.0, replica_counts=(1, 2, 4)):
     return out
 
 
+def bench_sharded_serving(
+    budget_s=180.0,
+    submeshes=((1, 1), (2, 1), (2, 2)),
+    precisions=("f32", "bf16", "int8"),
+):
+    """Sub-mesh serving sweep (docs/SERVING.md "Sharded serving &
+    precision tiers"): goodput/p99 through the REAL sub-mesh
+    EngineFleet for submesh {1x1, 2x1, 2x2} x precision {f32, bf16,
+    int8} on the local (forced, on CPU) devices. The CPU numbers
+    measure the dispatch+placement plane — whether carving devices
+    into sub-meshes or switching tiers adds host-side serialization —
+    plus the per-replica reload transfer bytes each layout actually
+    moves; chip MFU deltas for the tiers are TPU artifacts
+    (bench.py runs on-chip pick them up via the same stage)."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torch_actor_critic_tpu.models import Actor
+    from torch_actor_critic_tpu.serve import (
+        EngineFleet,
+        ModelRegistry,
+        ServeMetrics,
+    )
+
+    t_start = time.time()
+    actor = Actor(act_dim=ACT_DIM, hidden_sizes=HIDDEN)
+    params = actor.init(
+        jax.random.key(0), jnp.zeros((OBS_DIM,)), jax.random.key(1)
+    )
+    obs_spec = jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32)
+    obs = np.ones((OBS_DIM,), np.float32)
+    n_local = len(jax.local_devices())
+    out = {
+        "backend": jax.default_backend(),
+        "local_devices": n_local,
+        "combos": {},
+    }
+
+    def herd_window(act_fn, n_threads, window_s):
+        stop = threading.Event()
+        done = [0] * n_threads
+        errors = []
+
+        def worker(i):
+            while not stop.is_set():
+                try:
+                    act_fn(obs)
+                    done[i] += 1
+                except Exception as e:  # noqa: BLE001 — recorded
+                    errors.append(repr(e)[:200])
+                    return
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(n_threads)
+        ]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        time.sleep(window_s)
+        stop.set()
+        for th in threads:
+            th.join(timeout=60.0)
+        return sum(done), time.perf_counter() - t0, errors
+
+    n_combos = len(submeshes) * len(precisions)
+    window_s = max(1.0, min(3.0, budget_s / (n_combos * 3)))
+    for tp, fsdp in submeshes:
+        for precision in precisions:
+            name = f"{tp}x{fsdp}_{precision}"
+            if time.time() - t_start > budget_s - window_s - 5:
+                out["combos"][name] = {"skipped": "stage budget"}
+                continue
+            per = tp * fsdp
+            if per > n_local:
+                out["combos"][name] = {
+                    "skipped": f"needs {per} of {n_local} devices"
+                }
+                continue
+            devices = jax.local_devices()[: (n_local // per) * per]
+            registry = ModelRegistry()
+            registry.register(
+                "default", actor, obs_spec, params=params,
+                max_batch=8, warmup=False,
+            )
+            metrics = ServeMetrics()
+            try:
+                with EngineFleet(
+                    registry, devices=devices, max_batch=8,
+                    metrics=metrics, submesh=(tp, fsdp),
+                    precision=precision, fsdp_min_bytes=0,
+                ) as fleet:
+                    fleet.warmup()
+                    fleet.act(obs, timeout=30.0)  # rinse
+                    answered, elapsed, errors = herd_window(
+                        lambda o: fleet.act(o, timeout=30.0),
+                        n_threads=16, window_s=window_s,
+                    )
+                    snap = metrics.snapshot()
+                    stats = fleet.sharding_stats()
+                    entry = {
+                        "replicas": fleet.n_replicas,
+                        "goodput_rps": round(answered / elapsed, 1),
+                        "p50_ms": snap.get("p50_ms"),
+                        "p99_ms": snap.get("p99_ms"),
+                        "reload_transfer_bytes_per_replica": (
+                            stats["per_replica"][0]["last_transfer_bytes"]
+                        ),
+                    }
+                    if errors:
+                        entry["errors"] = errors[:3]
+                    out["combos"][name] = entry
+                    log(
+                        f"sharded {name}: {entry['replicas']} replicas, "
+                        f"{entry['goodput_rps']} rps, "
+                        f"p99 {entry['p99_ms']}ms, "
+                        f"{entry['reload_transfer_bytes_per_replica']}B/"
+                        "replica reload"
+                    )
+            except Exception as e:  # noqa: BLE001 — one combo's
+                # failure must not void the sweep
+                out["combos"][name] = {"error": repr(e)[:200]}
+            finally:
+                registry.close()
+    return out
+
+
 def bench_telemetry_overhead(budget_s=420.0):
     """Telemetry cost (docs/OBSERVABILITY.md zero-overhead contract):
     steady-state Trainer throughput with telemetry off vs on (full
@@ -2172,7 +2302,16 @@ _STAGES = {
     "visual": lambda: {"visual": bench_visual(budget_s=stage_budget(300.0))},
     "serving": lambda: {"serving": bench_serving()},
     "overload": lambda: {"overload": bench_overload()},
-    "fleet": lambda: {"fleet": bench_fleet()},
+    "fleet": lambda: {
+        "fleet": bench_fleet(),
+        # Sub-mesh serving sweep: submesh {1x1,2x1,2x2} x precision
+        # {f32,bf16,int8} goodput/p99 + per-replica reload transfer
+        # bytes, picked up by make bench-diff's goodput/_rps/_ms
+        # directions.
+        "fleet_sharded": bench_sharded_serving(
+            budget_s=stage_budget(180.0)
+        ),
+    },
     "decoupled": lambda: {"decoupled": bench_decoupled()},
     "host_envs": lambda: {"host_envs": bench_host_envs()},
     "telemetry_overhead": lambda: {

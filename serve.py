@@ -79,6 +79,22 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                           "'all' for one replica per local device "
                           "behind a shared admission layer + "
                           "least-loaded dispatch (serve/fleet.py)")
+    srv.add_argument("--submesh", default="1x1", metavar="TPxFSDP",
+                     help="Sub-mesh serving (serve/sharded.py; "
+                          "docs/SERVING.md 'Sharded serving'): carve "
+                          "--devices into disjoint TPxFSDP device "
+                          "groups, each hosting ONE GSPMD-sharded "
+                          "policy replica — params sharded by the "
+                          "training side's param_specs, so the model "
+                          "only needs to FIT sharded. '1x1' (default) "
+                          "keeps plain per-device replicas")
+    srv.add_argument("--serve-precision", choices=("f32", "bf16", "int8"),
+                     default="f32",
+                     help="Numeric serving tier: f32 is pinned "
+                          "bitwise-identical to the classic engine; "
+                          "bf16 runs matmuls at the MXU's native "
+                          "width; int8 serves per-channel "
+                          "weight-quantized params (dequant in-graph)")
     flt = p.add_argument_group("fleet (multi-process)")
     flt.add_argument("--fleet", type=int, default=0,
                      help="Spawn N serve.py worker processes and front "
@@ -353,9 +369,38 @@ def main(argv=None):
         [int(b) for b in args.buckets.split(",")] if args.buckets else None
     )
 
+    try:
+        tp, fsdp = (int(x) for x in args.submesh.lower().split("x"))
+    except ValueError:
+        raise SystemExit(
+            f"--submesh wants TPxFSDP (e.g. 2x2), got {args.submesh!r}"
+        ) from None
+    submesh = (tp, fsdp) if (tp, fsdp) != (1, 1) else None
+    sharded = submesh is not None or args.serve_precision != "f32"
+
+    # Direct-to-sharded hot-reload (docs/SERVING.md "Sharded serving"):
+    # with a sub-mesh, Orbax restores actor arrays straight into the
+    # first replica's NamedSharding layout — no host-RAM gather of a
+    # model that may not fit one host; further replicas reshard
+    # device-to-device via their generation-keyed placement.
+    restore_shardings = None
+    if submesh is not None:
+        import jax
+
+        from torch_actor_critic_tpu.parallel.sharding import (
+            make_submesh,
+            named_param_shardings,
+        )
+
+        mesh0 = make_submesh(jax.local_devices()[: tp * fsdp], tp, fsdp)
+        restore_shardings = (
+            lambda abstract: named_param_shardings(abstract, mesh0)
+        )
+
     registry = ModelRegistry(
         reload_retries=args.reload_retries,
         reload_retry_backoff_s=args.reload_retry_backoff,
+        restore_shardings=restore_shardings,
     )
     info = registry.register(
         "default", actor_def, obs_spec,
@@ -364,6 +409,10 @@ def main(argv=None):
             fail_threshold=args.breaker_threshold,
             cooldown_s=args.breaker_cooldown,
         ),
+        # In sharded mode the per-sub-mesh engines (warmed by the
+        # fleet below) serve every forward; warming the registry's
+        # single-device engine too would just buy unused compiles.
+        warmup=not sharded,
     )
     logger.info("model loaded: %s", info)
     if args.poll_interval > 0:
@@ -381,6 +430,11 @@ def main(argv=None):
         devices = len(jax.local_devices())
     else:
         devices = int(args.devices)
+    if sharded and devices % (tp * fsdp) != 0:
+        raise SystemExit(
+            f"--devices {devices} does not divide into --submesh "
+            f"{tp}x{fsdp} groups of {tp * fsdp}"
+        )
     server = PolicyServer(
         registry, host=args.host, port=args.port,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
@@ -390,7 +444,11 @@ def main(argv=None):
         capacity=args.queue_capacity,
         span_log=span_log,
         mode=args.batch_mode,
-        devices=devices if devices > 1 else None,
+        devices=(
+            devices if (devices > 1 or sharded) else None
+        ),
+        submesh=submesh,
+        precision=args.serve_precision,
     )
     # Rolling-restart contract: SIGTERM stops admissions, answers every
     # accepted request, then serve_forever returns and we exit 0.

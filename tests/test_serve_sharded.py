@@ -1,0 +1,649 @@
+"""GSPMD sub-mesh serving tests (docs/SERVING.md "Sharded serving &
+precision tiers"), on the forced 8-device CPU mesh (conftest.py):
+
+- the f32 tier's BITWISE pin against the single-device engine, every
+  bucket x deterministic/sampled — the compat contract;
+- at-rest params genuinely sharded (each device holds its shards);
+- a fleet of two (2,2) sub-meshes: dispatch across sub-meshes, shared
+  admission, breaker ejection of a WHOLE sub-mesh;
+- direct-to-sharded Orbax restore (no host-gather: arrays are born in
+  their NamedSharding layouts);
+- the int8 round-trip error bound and the bf16 tier;
+- hot-reload: one generation-keyed sharded transfer per replica
+  (transfer-bytes counter), NaN checkpoints rejected per sub-mesh
+  with last-good serving;
+- the (generation, precision) placement-cache key;
+- cost/watchdog identity ``serve/sharded_forward[bN]`` registered with
+  the sub-mesh devices divisor; the /metrics ``sharding`` section.
+"""
+
+import json
+import threading
+import time
+from urllib import request as urlreq
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_actor_critic_tpu.models import Actor, DoubleCritic
+from torch_actor_critic_tpu.parallel.sharding import (
+    make_submesh,
+    named_param_shardings,
+    partition_submeshes,
+)
+from torch_actor_critic_tpu.resilience.faultinject import corrupt_checkpoint
+from torch_actor_critic_tpu.sac import SAC
+from torch_actor_critic_tpu.serve import (
+    BreakerOpenError,
+    CircuitBreaker,
+    EngineFleet,
+    ModelRegistry,
+    PolicyEngine,
+    PolicyServer,
+    ServeMetrics,
+    ShardedPolicyEngine,
+)
+from torch_actor_critic_tpu.serve.sharded import (
+    Int8Param,
+    dequantize_params,
+    quantize_params,
+)
+from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
+from torch_actor_critic_tpu.utils.config import SACConfig
+
+OBS_DIM, ACT_DIM = 17, 6
+OBS = np.ones((OBS_DIM,), np.float32)
+
+
+def make_actor_and_params(seed=0):
+    actor = Actor(act_dim=ACT_DIM, hidden_sizes=(32, 32))
+    params = actor.init(
+        jax.random.key(seed), jnp.zeros((OBS_DIM,)), jax.random.key(1)
+    )
+    return actor, params
+
+
+def flat_spec():
+    return jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32)
+
+
+def submesh22():
+    return make_submesh(jax.devices()[:4], 2, 2)
+
+
+def sharded_engine(actor, precision="f32", mesh=None, max_batch=8):
+    return ShardedPolicyEngine(
+        actor, flat_spec(), mesh if mesh is not None else submesh22(),
+        precision=precision, max_batch=max_batch, fsdp_min_bytes=0,
+    )
+
+
+def wait_until(pred, timeout=30.0, msg="condition never became true"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(msg)
+
+
+# ------------------------------------------------------------ bitwise pin
+
+
+def test_sharded_f32_bitwise_every_bucket_det_and_sampled():
+    """THE acceptance pin: on the forced 8-device CPU mesh, the sharded
+    f32 engine answers bit-for-bit what the single-device PolicyEngine
+    answers — every bucket, deterministic AND sampled (same key). The
+    f32 tier's graph gathers params to replicated before any compute,
+    so the scalar program is identical; this test is why."""
+    actor, params = make_actor_and_params()
+    base = PolicyEngine(actor, flat_spec(), max_batch=8)
+    eng = sharded_engine(actor)
+    assert eng.buckets == base.buckets
+    placed, _ = eng.place_params(params)
+    rng = np.random.default_rng(0)
+    for bucket in eng.buckets:
+        for rows in (bucket - 1 or 1, bucket):  # padded + exact fits
+            obs = rng.standard_normal((rows, OBS_DIM)).astype(np.float32)
+            a_sh = eng.act(placed, obs, None, deterministic=True)
+            a_1 = base.act(params, obs, None, deterministic=True)
+            np.testing.assert_array_equal(a_sh, a_1)
+            key = jax.random.key(bucket * 1000 + rows)
+            s_sh = eng.act(placed, obs, key, deterministic=False)
+            s_1 = base.act(params, obs, key, deterministic=False)
+            np.testing.assert_array_equal(s_sh, s_1)
+
+
+def test_at_rest_params_are_sharded_per_device():
+    """The HBM story: placed params live SHARDED — every 2-D+ kernel's
+    per-device shard is strictly smaller than the array, and the
+    shards tile it exactly (the model only needs to FIT sharded)."""
+    actor, params = make_actor_and_params()
+    eng = sharded_engine(actor)
+    placed, transferred = eng.place_params(params)
+    kernels = [
+        leaf for leaf in jax.tree_util.tree_leaves(placed)
+        if leaf.ndim >= 2
+    ]
+    assert kernels, "test model has no kernels?"
+    sharded_count = 0
+    for leaf in kernels:
+        shard_shape = leaf.sharding.shard_shape(leaf.shape)
+        if shard_shape != leaf.shape:
+            sharded_count += 1
+            n_distinct = leaf.size // np.prod(shard_shape)
+            assert n_distinct > 1
+            # total stored = logical bytes x replication over the
+            # unsharded mesh axis (a P('fsdp')-only leaf on a (2,2)
+            # mesh keeps one copy per tp index)
+            assert sum(
+                s.data.nbytes for s in leaf.addressable_shards
+            ) == leaf.nbytes * (4 // n_distinct)
+    assert sharded_count > 0, "no kernel actually sharded at min_bytes=0"
+    # the transfer counter reports what was actually moved
+    expected = sum(
+        sum(s.data.nbytes for s in leaf.addressable_shards)
+        for leaf in jax.tree_util.tree_leaves(placed)
+    )
+    assert transferred == expected
+
+
+def test_submesh_construction_validation():
+    devs = jax.devices()
+    with pytest.raises(ValueError, match="exactly"):
+        make_submesh(devs[:3], 2, 2)
+    with pytest.raises(ValueError, match="divide"):
+        partition_submeshes(devs[:6], 2, 2)
+    assert len(partition_submeshes(devs[:8], 2, 2)) == 2
+    actor, _ = make_actor_and_params()
+    with pytest.raises(ValueError, match="precision"):
+        sharded_engine(actor, precision="fp8")
+    from jax.sharding import Mesh
+
+    wrong = Mesh(np.array(devs[:2]).reshape(2), axis_names=("dp",))
+    with pytest.raises(ValueError, match="tp, fsdp"):
+        ShardedPolicyEngine(actor, flat_spec(), wrong)
+
+
+# ------------------------------------------------------- precision tiers
+
+
+def test_int8_round_trip_error_bound():
+    """The quantization contract, pinned: per-channel symmetric int8
+    round-trips every weight to within half a scale step elementwise
+    (q = round(W/scale) => |W - q*scale| <= scale/2), biases/1-D
+    leaves pass through untouched, and the served int8 actions stay
+    close to f32's."""
+    actor, params = make_actor_and_params()
+    q = quantize_params(params)
+    deq = dequantize_params(q)
+    flat_w = jax.tree_util.tree_leaves_with_path(params)
+    flat_q = dict(jax.tree_util.tree_flatten_with_path(
+        q, is_leaf=lambda x: isinstance(x, Int8Param)
+    )[0])
+    quantized = 0
+    for path, w in flat_w:
+        qleaf = flat_q[path]
+        if w.ndim >= 2:
+            assert isinstance(qleaf, Int8Param)
+            assert qleaf.q.dtype == np.int8
+            assert qleaf.scale.shape == (w.shape[-1],)
+            quantized += 1
+        else:
+            np.testing.assert_array_equal(qleaf, w)
+    assert quantized >= 4  # trunk + heads
+    for (path, w), (_, d) in zip(
+        flat_w, jax.tree_util.tree_leaves_with_path(deq)
+    ):
+        if np.asarray(w).ndim >= 2:
+            scale = np.asarray(flat_q[path].scale)
+            err = np.abs(np.asarray(w) - np.asarray(d))
+            assert (err <= scale * 0.5 + 1e-7).all(), (
+                f"{path}: max err {err.max()} > scale/2"
+            )
+    # end-to-end: int8 serving tracks f32 closely on the test model
+    eng = sharded_engine(actor, precision="int8")
+    base = PolicyEngine(actor, flat_spec(), max_batch=8)
+    placed, nbytes_int8 = eng.place_params(params)
+    obs = np.random.default_rng(3).standard_normal(
+        (8, OBS_DIM)
+    ).astype(np.float32)
+    a8 = eng.act(placed, obs, None, deterministic=True)
+    a32 = base.act(params, obs, None, deterministic=True)
+    assert np.isfinite(a8).all()
+    np.testing.assert_allclose(a8, a32, atol=0.05)
+    # int8 weights cross to the devices at a quarter of the f32 kernel
+    # bytes — the placement must actually be smaller
+    _, nbytes_f32 = sharded_engine(actor).place_params(params)
+    assert nbytes_int8 < nbytes_f32
+
+
+def test_bf16_tier_tracks_f32():
+    actor, params = make_actor_and_params()
+    eng = sharded_engine(actor, precision="bf16")
+    assert eng.precision == "bf16"
+    placed, _ = eng.place_params(params)
+    base = PolicyEngine(actor, flat_spec(), max_batch=8)
+    obs = np.random.default_rng(4).standard_normal(
+        (4, OBS_DIM)
+    ).astype(np.float32)
+    a16 = eng.act(placed, obs, None, deterministic=True)
+    a32 = base.act(params, obs, None, deterministic=True)
+    assert a16.dtype == np.float32  # heads return f32 (PR-12 policy)
+    assert np.isfinite(a16).all()
+    np.testing.assert_allclose(a16, a32, atol=0.02)
+    assert not np.array_equal(a16, a32), (
+        "bf16 bitwise-equal to f32 — the tier is not actually running "
+        "reduced-precision matmuls"
+    )
+
+
+# ------------------------------------------------------------- the fleet
+
+
+def make_sharded_fleet(reg, metrics=None, precision="f32", **kw):
+    return EngineFleet(
+        reg, devices=jax.devices()[:8], max_batch=8,
+        metrics=metrics, submesh=(2, 2), precision=precision,
+        fsdp_min_bytes=0, **kw,
+    )
+
+
+def test_fleet_two_submeshes_dispatch_and_bitwise():
+    """Acceptance: 8 devices become TWO (2,2) sub-mesh replicas; a
+    concurrent flood spreads over both, every response is
+    bitwise-equal to the single-device engine, and /metrics-visible
+    dispatch counters prove both sub-meshes served."""
+    actor, params = make_actor_and_params()
+    reg = ModelRegistry()
+    reg.register(
+        "default", actor, flat_spec(), params=params, max_batch=8,
+        warmup=False,
+    )
+    base = PolicyEngine(actor, flat_spec(), max_batch=8)
+    metrics = ServeMetrics()
+    with make_sharded_fleet(reg, metrics) as fleet:
+        assert fleet.n_replicas == 2
+        fleet.warmup()
+        rng = np.random.default_rng(5)
+        obs_batches = [
+            rng.standard_normal((3, OBS_DIM)).astype(np.float32)
+            for _ in range(24)
+        ]
+        expected = [
+            base.act(params, o, None, deterministic=True)
+            for o in obs_batches
+        ]
+        results = [None] * len(obs_batches)
+
+        def worker(i):
+            results[i] = fleet.act(obs_batches[i], timeout=60.0)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(len(obs_batches))
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120.0)
+        for got, want in zip(results, expected):
+            assert got is not None
+            np.testing.assert_array_equal(got.action, want)
+        dispatched = [rep.dispatched for rep in fleet._replicas]
+        assert all(d > 0 for d in dispatched), dispatched
+        snap = metrics.snapshot()
+        assert snap["responses_total"] == len(obs_batches)
+        # one placement per sub-mesh replica, counted
+        assert snap["param_placements_total"] == 2
+    reg.close()
+
+
+def test_fleet_breaker_ejects_whole_submesh():
+    """A sick sub-mesh (its engine raising) trips ITS breaker and
+    leaves rotation — traffic continues on the surviving sub-mesh;
+    both open => fleet-level structured shed."""
+    base_breaker = CircuitBreaker(fail_threshold=1, cooldown_s=3600.0)
+    actor, params = make_actor_and_params()
+    reg = ModelRegistry()
+    reg.register(
+        "default", actor, flat_spec(), params=params, max_batch=8,
+        warmup=False, breaker=base_breaker,
+    )
+    with make_sharded_fleet(reg) as fleet:
+        fleet.warmup()
+        # Make sub-mesh 0's engine fail: its breaker must trip and
+        # eject the WHOLE 4-device group from rotation.
+        engine0, _, _ = fleet._replicas[0].registry.acquire("default")
+        engine0.act = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("injected sub-mesh fault")
+        )
+        br0 = fleet._replicas[0].registry.breaker("default")
+        failures = 0
+        for _ in range(8):
+            try:
+                fleet.act(OBS, timeout=30.0)
+            except RuntimeError:
+                failures += 1
+            if br0.state == "open":
+                break
+        assert br0.state == "open"
+        assert failures >= 1
+        before = fleet._replicas[1].dispatched
+        for _ in range(4):
+            r = fleet.act(OBS, timeout=30.0)
+            assert r.action.shape == (ACT_DIM,)
+        assert fleet._replicas[1].dispatched == before + 4
+        d0 = fleet._replicas[0].dispatched
+        # the whole fleet tripped => structured BreakerOpenError
+        br1 = fleet._replicas[1].registry.breaker("default")
+        br1.record_failure(RuntimeError("injected"))
+        with pytest.raises(BreakerOpenError):
+            fleet.act(OBS, timeout=30.0)
+        assert fleet._replicas[0].dispatched == d0  # stayed ejected
+    reg.close()
+
+
+def test_placement_cache_keys_on_generation_and_precision():
+    """Satellite pin: the per-replica placement cache keys on
+    ``(generation, precision)`` — a generation bump re-places, a
+    precision-tier change re-places (stale-dtype params can never
+    serve), and a repeat acquire with neither changed is a cache
+    hit."""
+    actor, params = make_actor_and_params()
+    reg = ModelRegistry()
+    reg.register(
+        "default", actor, flat_spec(), params=params, max_batch=8,
+        warmup=False,
+    )
+    from torch_actor_critic_tpu.serve.fleet import _SubmeshReplicaRegistry
+
+    view = _SubmeshReplicaRegistry(reg, submesh22(), 0, precision="f32",
+                                   fsdp_min_bytes=0)
+    _, placed_a, gen_a = view.acquire()
+    assert view.placements_total == 1
+    _, placed_b, _ = view.acquire()  # same generation+precision: hit
+    assert view.placements_total == 1
+    assert placed_b is placed_a
+    reg.swap("default", params)  # generation bump: miss
+    _, _, gen_b = view.acquire()
+    assert gen_b == gen_a + 1
+    assert view.placements_total == 2
+    # precision-tier change (engine replaced by a different-tier twin):
+    # the cache must MISS even though the generation is unchanged —
+    # placed f32 leaves are stale-dtype for the int8 engine.
+    eng = view._engines["default"]
+    view._engines["default"] = ShardedPolicyEngine(
+        eng.actor_def, eng.obs_spec, view.mesh, precision="int8",
+        max_batch=eng.max_batch, buckets=eng.buckets, fsdp_min_bytes=0,
+    )
+    _, placed_c, _ = view.acquire()
+    assert view.placements_total == 3
+    assert any(
+        isinstance(leaf, Int8Param)
+        for leaf in jax.tree_util.tree_leaves(
+            placed_c, is_leaf=lambda x: isinstance(x, Int8Param)
+        )
+    )
+    reg.close()
+
+
+# --------------------------------------------------- sharded restore
+
+
+def _save_checkpoint(ckpt_dir, epoch, seed):
+    cfg = SACConfig(hidden_sizes=(32, 32))
+    sac = SAC(
+        cfg,
+        Actor(act_dim=ACT_DIM, hidden_sizes=(32, 32)),
+        DoubleCritic(hidden_sizes=(32, 32)),
+        ACT_DIM,
+    )
+    state = sac.init_state(jax.random.key(seed), jnp.zeros((OBS_DIM,)))
+    ck = Checkpointer(ckpt_dir, save_buffer=False)
+    try:
+        ck.save(epoch, state, extra={"config": cfg.to_json()}, wait=True)
+    finally:
+        ck.close()
+    return state.actor_params
+
+
+def test_restore_actor_params_directly_into_shardings(tmp_path):
+    """The no-host-gather proof: ``restore_actor_params(shardings=)``
+    lands every sharded-spec actor array ALREADY in its NamedSharding
+    layout — born sharded, per-device shards strictly smaller than the
+    array, no fully-replicated copy of any sharded parameter — and
+    bitwise-equal to the plain restore."""
+    ckpt_dir = tmp_path / "ckpts"
+    expected = _save_checkpoint(ckpt_dir, 0, seed=0)
+    mesh = submesh22()
+    ck = Checkpointer(ckpt_dir, save_buffer=False)
+    try:
+        plain, _ = ck.restore_actor_params()
+        params, meta = ck.restore_actor_params(
+            shardings=lambda abstract: named_param_shardings(
+                abstract, mesh, min_bytes=0
+            )
+        )
+    finally:
+        ck.close()
+    assert meta["epoch"] == 0
+    sharded_leaves = 0
+    for (path, leaf), (_, ref) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(expected),
+    ):
+        np.testing.assert_array_equal(np.asarray(leaf), np.asarray(ref))
+        shard_shape = leaf.sharding.shard_shape(leaf.shape)
+        if shard_shape != leaf.shape:
+            sharded_leaves += 1
+            assert not leaf.sharding.is_fully_replicated
+            # no replicated intermediate >= param size: the per-device
+            # bytes of this array are exactly its shard, and all
+            # shards together store the array ONCE.
+            per_device = max(
+                s.data.nbytes for s in leaf.addressable_shards
+            )
+            assert per_device < leaf.nbytes
+            n_distinct = leaf.size // np.prod(shard_shape)
+            assert sum(
+                s.data.nbytes for s in leaf.addressable_shards
+            ) == leaf.nbytes * (mesh.size // n_distinct)
+    assert sharded_leaves > 0
+    # the plain restore is the compat path and agrees bitwise
+    for a, b in zip(
+        jax.tree_util.tree_leaves(plain),
+        jax.tree_util.tree_leaves(params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ----------------------------------------------------- reload contracts
+
+
+def test_sharded_reload_one_transfer_per_replica(tmp_path):
+    """Hot-reload stays one transfer per device: each sub-mesh replica
+    performs exactly ONE generation-keyed sharded placement per
+    reload, asserted via the transfer-bytes counter (placements = one
+    initial + one per reload, per replica)."""
+    ckpt_dir = tmp_path / "ckpts"
+    _save_checkpoint(ckpt_dir, 0, seed=0)
+    actor, _ = make_actor_and_params()
+    reg = ModelRegistry()
+    reg.register(
+        "default", actor, flat_spec(), ckpt_dir=str(ckpt_dir),
+        max_batch=8, warmup=False,
+    )
+    metrics = ServeMetrics()
+    with make_sharded_fleet(reg, metrics) as fleet:
+        for _ in range(4):  # touch both replicas (round-robin)
+            assert fleet.act(OBS, timeout=30.0).generation == 0
+        snap = metrics.snapshot()
+        assert snap["param_placements_total"] == 2  # one per replica
+        bytes_initial = snap["reload_transfer_bytes_total"]
+        assert bytes_initial > 0
+
+        _save_checkpoint(ckpt_dir, 1, seed=9)
+        out = reg.reload()
+        assert out["default"]["status"] == "ok"
+        for _ in range(4):
+            assert fleet.act(OBS, timeout=30.0).generation == 1
+        snap = metrics.snapshot()
+        assert snap["param_placements_total"] == 4  # exactly +1 each
+        assert snap["reload_transfer_bytes_total"] == 2 * bytes_initial
+        stats = fleet.sharding_stats()
+        for rep in stats["per_replica"]:
+            assert rep["placements_total"] == 2
+            assert rep["transfer_bytes_total"] == bytes_initial
+    reg.close()
+
+
+def test_sharded_reload_rejects_nan_keeps_last_good(tmp_path):
+    """A NaN checkpoint is rejected by the sentinel BEFORE any
+    sub-mesh sees it: every replica keeps serving the last-good
+    generation bit-for-bit (no placement happens), and a later good
+    epoch rolls out normally."""
+    ckpt_dir = tmp_path / "ckpts"
+    _save_checkpoint(ckpt_dir, 0, seed=0)
+    actor, _ = make_actor_and_params()
+    reg = ModelRegistry()
+    reg.register(
+        "default", actor, flat_spec(), ckpt_dir=str(ckpt_dir),
+        max_batch=8, warmup=False,
+    )
+    metrics = ServeMetrics()
+    with make_sharded_fleet(reg, metrics) as fleet:
+        before = [fleet.act(OBS, timeout=30.0) for _ in range(2)]
+        assert all(r.generation == 0 for r in before)
+
+        _save_checkpoint(ckpt_dir, 1, seed=99)
+        corrupt_checkpoint(ckpt_dir, 1, mode="nan-params")
+        out = reg.reload()
+        assert out["default"]["status"] == "rejected"
+        placements = metrics.snapshot()["param_placements_total"]
+        after = [fleet.act(OBS, timeout=30.0) for _ in range(2)]
+        for a, b in zip(after, before):
+            assert a.generation == 0
+            np.testing.assert_array_equal(a.action, b.action)
+        # rejection never re-placed anything on any sub-mesh
+        assert metrics.snapshot()["param_placements_total"] == placements
+
+        _save_checkpoint(ckpt_dir, 2, seed=5)
+        out = reg.reload()
+        assert out["default"]["status"] == "ok"
+        assert fleet.act(OBS, timeout=30.0).generation == 1
+    reg.close()
+
+
+# ----------------------------------------------- cost, metrics, server
+
+
+def test_cost_identity_registered_per_chip():
+    """Warmup registers ``serve/sharded_forward[bN]`` in the cost
+    registry with ``devices`` = the sub-mesh size, so roofline/MFU
+    compares one chip against one chip's peak (the PR-8 convention)."""
+    from torch_actor_critic_tpu.telemetry.costmodel import (
+        get_cost_registry,
+    )
+
+    actor, params = make_actor_and_params()
+    eng = sharded_engine(actor, max_batch=4)
+    placed, _ = eng.place_params(params)
+    eng.warmup(placed, deterministic_only=True)
+    for bucket in eng.buckets:
+        cost = get_cost_registry().get(f"serve/sharded_forward[b{bucket}]")
+        assert cost is not None
+        assert cost["devices"] == 4
+        assert cost["flops"] > 0
+
+
+def test_metrics_sharding_section_over_http():
+    """/metrics grows a ``sharding`` section: sub-mesh shape, precision
+    tier, per-replica transfer accounting — and the fleet section
+    names all four devices of each sub-mesh."""
+    actor, params = make_actor_and_params()
+    reg = ModelRegistry()
+    reg.register(
+        "default", actor, flat_spec(), params=params, max_batch=8,
+        warmup=False,
+    )
+    server = PolicyServer(
+        reg, port=0, max_batch=8, devices=jax.devices()[:8],
+        submesh=(2, 2), precision="int8", fsdp_min_bytes=0,
+    ).start()
+    try:
+        obs = OBS.tolist()
+        req = urlreq.Request(
+            server.address + "/act",
+            data=json.dumps({"obs": obs}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        out = json.loads(urlreq.urlopen(req, timeout=30).read())
+        assert len(out["action"]) == ACT_DIM
+        snap = json.loads(
+            urlreq.urlopen(server.address + "/metrics", timeout=30).read()
+        )
+        sh = snap["sharding"]
+        assert sh["submesh"] == {"tp": 2, "fsdp": 2}
+        assert sh["precision"] == "int8"
+        assert sh["replicas"] == 2
+        assert len(sh["per_replica"]) == 2
+        assert all(
+            len(r["devices"]) == 4 for r in sh["per_replica"]
+        )
+        warmed = [
+            r for r in sh["per_replica"] if r["placements_total"] > 0
+        ]
+        assert warmed and all(
+            r["transfer_bytes_total"] > 0 for r in warmed
+        )
+        assert snap["reload_transfer_bytes_total"] > 0
+    finally:
+        server.close()
+
+
+def test_serve_cli_flags_parse_and_validate():
+    import serve as serve_cli
+
+    args = serve_cli.parse_arguments(
+        ["--ckpt-dir", "/tmp/x", "--obs-dim", "4", "--act-dim", "2"]
+    )
+    assert args.submesh == "1x1"
+    assert args.serve_precision == "f32"
+    args = serve_cli.parse_arguments(
+        ["--ckpt-dir", "/tmp/x", "--obs-dim", "4", "--act-dim", "2",
+         "--devices", "all", "--submesh", "2x2",
+         "--serve-precision", "bf16"]
+    )
+    assert args.submesh == "2x2"
+    assert args.serve_precision == "bf16"
+    with pytest.raises(SystemExit):
+        serve_cli.parse_arguments(
+            ["--ckpt-dir", "/tmp/x", "--serve-precision", "fp64"]
+        )
+
+
+def test_precision_only_fleet_uses_single_device_submeshes():
+    """A precision tier without an explicit submesh runs on (1,1)
+    sub-meshes — every device gets the tier, replica count
+    unchanged."""
+    actor, params = make_actor_and_params()
+    reg = ModelRegistry()
+    reg.register(
+        "default", actor, flat_spec(), params=params, max_batch=8,
+        warmup=False,
+    )
+    with EngineFleet(
+        reg, devices=jax.devices()[:2], max_batch=8, precision="bf16",
+        fsdp_min_bytes=0,
+    ) as fleet:
+        assert fleet.n_replicas == 2
+        assert fleet.submesh == (1, 1)
+        r = fleet.act(OBS, timeout=30.0)
+        assert np.isfinite(r.action).all()
+        stats = fleet.sharding_stats()
+        assert stats["precision"] == "bf16"
+        assert stats["devices_per_replica"] == 1
+    reg.close()

@@ -81,7 +81,10 @@ ENTRY_POINTS: t.Dict[str, t.Tuple[str, str]] = {
     "train/scenario_epoch": (
         "scenarios/loop.py", "ScenarioOnDeviceLoop._build_epoch",
     ),
-    "serve/forward": ("serve/engine.py", "PolicyEngine.__init__"),
+    "serve/forward": ("serve/engine.py", "PolicyEngine._build_forwards"),
+    "serve/sharded_forward": (
+        "serve/sharded.py", "ShardedPolicyEngine._build_forwards",
+    ),
 }
 
 # Method names too generic for the cross-class fallback resolution.

@@ -151,6 +151,65 @@ def param_specs(
     return jax.tree_util.tree_map_with_path(one, params)
 
 
+def make_submesh(
+    devices: t.Sequence[jax.Device], tp: int, fsdp: int
+) -> Mesh:
+    """A serving sub-mesh: exactly ``tp * fsdp`` devices as a 2-axis
+    ``(tp, fsdp)`` Mesh. The serving-side counterpart of
+    :func:`~torch_actor_critic_tpu.parallel.mesh.make_mesh` — no
+    ``dp``/``sp`` axes because one serving replica IS one model copy
+    (the fleet's dispatcher is the data-parallel axis), and
+    :func:`param_specs` only reads ``tp``/``fsdp``."""
+    import numpy as np
+
+    if tp < 1 or fsdp < 1:
+        raise ValueError(f"submesh axes must be >= 1, got {tp}x{fsdp}")
+    if len(devices) != tp * fsdp:
+        raise ValueError(
+            f"submesh {tp}x{fsdp} needs exactly {tp * fsdp} devices, "
+            f"got {len(devices)}"
+        )
+    grid = np.asarray(list(devices)).reshape(tp, fsdp)
+    return Mesh(grid, axis_names=("tp", "fsdp"))
+
+
+def partition_submeshes(
+    devices: t.Sequence[jax.Device], tp: int, fsdp: int
+) -> t.List[Mesh]:
+    """Carve a device list into disjoint ``(tp, fsdp)`` sub-meshes —
+    the Sebulba move (PAPERS.md): the fleet dispatches across model
+    REPLICAS, each a sharded copy over its own slice of the topology.
+    The device count must divide evenly: silently idling the tail
+    chips would misreport capacity."""
+    per = tp * fsdp
+    if not devices:
+        raise ValueError("partition_submeshes needs at least one device")
+    if len(devices) % per != 0:
+        raise ValueError(
+            f"{len(devices)} devices do not divide into {tp}x{fsdp} "
+            f"sub-meshes of {per}; pass a device count that is a "
+            "multiple (or change --submesh)"
+        )
+    devices = list(devices)
+    return [
+        make_submesh(devices[i:i + per], tp, fsdp)
+        for i in range(0, len(devices), per)
+    ]
+
+
+def named_param_shardings(
+    params: t.Any, mesh: Mesh, min_bytes: int = FSDP_MIN_BYTES
+) -> t.Any:
+    """:func:`param_specs` as a pytree of :class:`NamedSharding` —
+    ready for ``device_put`` placement, jit ``in_shardings``, or the
+    direct-to-sharded Orbax restore
+    (:meth:`~torch_actor_critic_tpu.utils.checkpoint.Checkpointer.restore_actor_params`
+    ``shardings=``)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_specs(params, mesh, min_bytes)
+    )
+
+
 def shard_params(
     params: t.Any, mesh: Mesh, min_bytes: int = FSDP_MIN_BYTES
 ) -> t.Any:

@@ -30,6 +30,13 @@ forward pass, and the TorchBeast server-side dynamic-batching pattern
   least-loaded (``load_rows × seconds-per-row EMA``) and health-gated
   on per-replica breakers; hot-reload propagates by generation-keyed
   params placement.
+- :mod:`~torch_actor_critic_tpu.serve.sharded` — GSPMD sub-mesh
+  serving (docs/SERVING.md "Sharded serving & precision tiers"): one
+  policy replica sharded over a ``(tp, fsdp)`` device group via the
+  training side's ``param_specs``, so the fleet serves models too big
+  for a single chip's HBM; plus the low-precision tiers (``bf16``,
+  weight-quantized ``int8``) behind a bitwise-pinned ``f32`` compat
+  mode.
 - :mod:`~torch_actor_critic_tpu.serve.router` — the multi-process
   fleet router (``serve.py --fleet N``): health-gated membership over
   N workers (eject draining/breaker-open/unreachable, re-admit on
@@ -62,6 +69,9 @@ from torch_actor_critic_tpu.serve.metrics import (  # noqa: F401
     aggregate_snapshots,
 )
 from torch_actor_critic_tpu.serve.registry import ModelRegistry  # noqa: F401
+from torch_actor_critic_tpu.serve.sharded import (  # noqa: F401
+    ShardedPolicyEngine,
+)
 from torch_actor_critic_tpu.serve.router import FleetRouter  # noqa: F401
 from torch_actor_critic_tpu.serve.server import (  # noqa: F401
     PolicyClient,

@@ -74,7 +74,18 @@ class PolicyEngine:
     env layer exposes (``pool.obs_spec``). Thread-safe: jitted
     executables are immutable once built, and the cache dict is guarded
     for the build-on-miss path.
+
+    Subclass seams (:mod:`~torch_actor_critic_tpu.serve.sharded` uses
+    all of them): :meth:`_build_forwards` constructs the jitted
+    ``self._fwd`` pair, :attr:`TRACE_PREFIX` names the watchdog/cost
+    identity, :attr:`precision` tags the numeric tier the fleet keys
+    its params-placement cache on, and :meth:`_cost_devices` is the
+    per-chip divisor the warmup cost registration records.
     """
+
+    # Watchdog source / cost-registry identity prefix; per-bucket names
+    # are f"{TRACE_PREFIX}[b{N}]".
+    TRACE_PREFIX = "serve/forward"
 
     def __init__(
         self,
@@ -97,6 +108,38 @@ class PolicyEngine:
                 f"{self.max_batch}: requests between them could never "
                 "be padded to a compiled shape"
             )
+        self._build_forwards()
+        self._compiled: set = set()  # {(bucket, det)}; guarded-by: _lock
+        self._lock = threading.Lock()
+        # Precomputed jax.profiler span labels (one per bucket): under
+        # an active trace each serving forward shows up as a labeled
+        # span; with no trace the annotation is a no-op TraceMe, so the
+        # serving hot path pays ~nothing (docs/OBSERVABILITY.md).
+        self._trace_names = {
+            b: f"{self.TRACE_PREFIX}[b{b}]" for b in self.buckets
+        }
+        # Compile accounting (docs/OBSERVABILITY.md recompile
+        # watchdog): per-bucket warmup vs LIVE compile counts — a
+        # silently-recompiling bucket was previously indistinguishable
+        # from a slow one. First-seen (bucket, deterministic) keys
+        # count here; the process-wide watchdog additionally attributes
+        # every real backend compile (including re-compiles of
+        # already-seen keys) to this engine's `serve/forward[bN]`
+        # source labels and flags post-steady ones as anomalies.
+        self._compile_counts: t.Dict[int, t.List[int]] = (  # guarded-by: _lock
+            {}
+        )  # bucket -> [warmup, live]
+        self.compiles_total = 0  # guarded-by: _lock
+        self._warmup_active = False  # guarded-by: _lock
+        self._warmed = False  # guarded-by: _lock
+        self._watchdog = get_watchdog().install()
+
+    def _build_forwards(self) -> None:
+        """Construct the jitted ``self._fwd`` pair (``{True:
+        deterministic, False: sampled}``). Subclasses override to change
+        the program (the sub-mesh engine jits with shardings) while the
+        bucketing/padding/compile-accounting machinery above stays
+        shared."""
         # Donating the padded obs buffer lets XLA reuse its HBM for the
         # output on accelerators; on CPU donation is unsupported and
         # only produces warnings, so gate it. The PRNG key is NOT
@@ -131,30 +174,36 @@ class PolicyEngine:
                 fwd_sampled, donate_argnums=(1,) if donate else ()
             ),
         }
-        self._compiled: set = set()  # {(bucket, det)}; guarded-by: _lock
-        self._lock = threading.Lock()
-        # Precomputed jax.profiler span labels (one per bucket): under
-        # an active trace each serving forward shows up as a labeled
-        # span; with no trace the annotation is a no-op TraceMe, so the
-        # serving hot path pays ~nothing (docs/OBSERVABILITY.md).
-        self._trace_names = {
-            b: f"serve/forward[b{b}]" for b in self.buckets
-        }
-        # Compile accounting (docs/OBSERVABILITY.md recompile
-        # watchdog): per-bucket warmup vs LIVE compile counts — a
-        # silently-recompiling bucket was previously indistinguishable
-        # from a slow one. First-seen (bucket, deterministic) keys
-        # count here; the process-wide watchdog additionally attributes
-        # every real backend compile (including re-compiles of
-        # already-seen keys) to this engine's `serve/forward[bN]`
-        # source labels and flags post-steady ones as anomalies.
-        self._compile_counts: t.Dict[int, t.List[int]] = (  # guarded-by: _lock
-            {}
-        )  # bucket -> [warmup, live]
-        self.compiles_total = 0  # guarded-by: _lock
-        self._warmup_active = False  # guarded-by: _lock
-        self._warmed = False  # guarded-by: _lock
-        self._watchdog = get_watchdog().install()
+
+    @property
+    def precision(self) -> str:
+        """Numeric serving tier. The base engine always computes in
+        f32; the sub-mesh engine's tiers override this. The fleet keys
+        its per-replica params-placement cache on
+        ``(generation, precision)`` so a tier change can never serve
+        stale-dtype params (docs/SERVING.md "Sharded serving")."""
+        return "f32"
+
+    def _cost_devices(self) -> int:
+        """Mesh size the warmup cost registration divides by, so the
+        registered FLOPs/bytes are PER-CHIP (one chip vs one chip's
+        peak in roofline/MFU — the PR-8 convention)."""
+        return 1
+
+    def prepare_params(self, params):
+        """Transform raw checkpoint params into what :meth:`act`
+        consumes — identity here; the int8 tier quantizes
+        (register/reload time, NEVER per request)."""
+        return params
+
+    def _device_obs(self, padded):
+        """Pre-place one padded observation pytree for the forward
+        (identity here: jit moves host arrays itself)."""
+        return padded
+
+    def _device_key(self, key):
+        """Pre-place the sampled-action PRNG key (identity here)."""
+        return key
 
     def replicate(self) -> "PolicyEngine":
         """A fresh engine with this one's configuration and an EMPTY
@@ -226,7 +275,7 @@ class PolicyEngine:
         of n <= max bucket rows; returns the n action rows."""
         n = int(jax.tree_util.tree_leaves(obs)[0].shape[0])
         bucket = self.bucket_for(n)
-        padded = self._pad(obs, n, bucket)
+        padded = self._device_obs(self._pad(obs, n, bucket))
         with self._watchdog.source(self._trace_names[bucket]), \
                 jax.profiler.TraceAnnotation(self._trace_names[bucket]):
             if deterministic:
@@ -234,7 +283,9 @@ class PolicyEngine:
             else:
                 if key is None:
                     raise ValueError("sampled serving needs a PRNG key")
-                out, finite = self._fwd[False](params, padded, key)
+                out, finite = self._fwd[False](
+                    params, padded, self._device_key(key)
+                )
         with self._lock:
             key_ = (bucket, bool(deterministic))
             if key_ not in self._compiled:
@@ -308,6 +359,7 @@ class PolicyEngine:
                             zero_obs,
                         ),
                         compiled=False,
+                        devices=self._cost_devices(),
                     )
                     for det in (True,) if deterministic_only else (True, False):
                         if det:

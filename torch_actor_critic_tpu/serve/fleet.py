@@ -82,33 +82,65 @@ class _ReplicaRegistry:
     checkpoint plumbing all stay in the one shared registry.
     """
 
-    def __init__(self, base, device, index: int):
+    def __init__(self, base, device, index: int, metrics=None):
         self._base = base
         self.device = device
         self.index = index
+        self.metrics = metrics
         self._engines: t.Dict[str, PolicyEngine] = {}  # guarded-by: _lock
-        self._params: t.Dict[str, t.Tuple[int, t.Any]] = (  # guarded-by: _lock
-            {}
-        )
+        # name -> (generation, precision, placed): keyed on BOTH so a
+        # precision-tier change invalidates cached placements instead
+        # of serving stale-dtype params (a reload bumps the generation,
+        # a tier flip bumps the precision — either way the cache
+        # misses and the params are re-prepared + re-placed).
+        self._params: t.Dict[
+            str, t.Tuple[int, str, t.Any]
+        ] = {}  # guarded-by: _lock
         self._breakers: t.Dict[str, CircuitBreaker] = {}  # guarded-by: _lock
+        # Placement accounting for /metrics `sharding`: every
+        # device_put's actual bytes, totalled per replica.
+        self.transfer_bytes_total = 0  # guarded-by: _lock
+        self.last_transfer_bytes = 0  # guarded-by: _lock
+        self.placements_total = 0  # guarded-by: _lock
         self._lock = threading.Lock()
+
+    def _new_engine(self, base_engine: PolicyEngine) -> PolicyEngine:
+        """This replica's engine for a slot — a fresh single-device
+        twin of the shared slot engine (the sub-mesh view overrides
+        with a :class:`ShardedPolicyEngine` on its mesh)."""
+        return base_engine.replicate()
+
+    def _place(self, engine: PolicyEngine, params) -> t.Tuple[t.Any, int]:
+        """Place one slot's params for this replica; returns
+        ``(placed, transferred_bytes)``."""
+        placed = jax.device_put(params, self.device)
+        nbytes = int(sum(
+            getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree_util.tree_leaves(placed)
+        ))
+        return placed, nbytes
 
     def acquire(self, name: str = "default"):
         base_engine, params, generation = self._base.acquire(name)
         with self._lock:
             engine = self._engines.get(name)
             if engine is None:
-                engine = base_engine.replicate()
+                engine = self._new_engine(base_engine)
                 self._engines[name] = engine
             cached = self._params.get(name)
-            if cached is None or cached[0] != generation:
+            if cached is None or cached[:2] != (generation, engine.precision):
                 # One transfer per hot-reload per device, performed
                 # lazily on the replica's next dispatch — never on the
                 # reload path itself (reload latency stays O(1 restore),
                 # not O(devices)).
-                placed = jax.device_put(params, self.device)
-                self._params[name] = (generation, placed)
-            return engine, self._params[name][1], generation
+                placed, nbytes = self._place(engine, params)
+                self._params[name] = (generation, engine.precision, placed)
+                self.transfer_bytes_total += nbytes
+                self.last_transfer_bytes = nbytes
+                self.placements_total += 1
+                if self.metrics is not None:
+                    self.metrics.record_transfer(nbytes)
+            return engine, self._params[name][2], generation
 
     def epoch_of(self, name: str = "default") -> int | None:
         """Epoch stamping delegates to the shared registry — every
@@ -156,6 +188,54 @@ class _ReplicaRegistry:
             engines = dict(self._engines)
         return {name: e.compile_stats() for name, e in engines.items()}
 
+    def transfer_stats(self) -> dict:
+        """Placement accounting for the ``/metrics`` ``sharding``
+        section: cumulative + last-reload transfer bytes and how many
+        placements (generation or precision changes) this replica has
+        performed."""
+        with self._lock:
+            return {
+                "transfer_bytes_total": self.transfer_bytes_total,
+                "last_transfer_bytes": self.last_transfer_bytes,
+                "placements_total": self.placements_total,
+            }
+
+
+class _SubmeshReplicaRegistry(_ReplicaRegistry):
+    """A per-SUB-MESH view over the shared registry: the replica's
+    engine is a :class:`~torch_actor_critic_tpu.serve.sharded.
+    ShardedPolicyEngine` over its own ``(tp, fsdp)`` mesh, and params
+    placement is the engine's prepare (int8 quantization at reload
+    time) + sharded ``device_put`` — each device of the sub-mesh
+    receives exactly its shards, still one transfer per device per
+    generation."""
+
+    def __init__(
+        self, base, mesh, index: int, precision: str = "f32",
+        fsdp_min_bytes: int | None = None, metrics=None,
+    ):
+        super().__init__(base, device=mesh, index=index, metrics=metrics)
+        self.mesh = mesh
+        self.precision = precision
+        self.fsdp_min_bytes = fsdp_min_bytes
+
+    def _new_engine(self, base_engine: PolicyEngine) -> PolicyEngine:
+        from torch_actor_critic_tpu.parallel.sharding import FSDP_MIN_BYTES
+        from torch_actor_critic_tpu.serve.sharded import ShardedPolicyEngine
+
+        return ShardedPolicyEngine(
+            base_engine.actor_def, base_engine.obs_spec, self.mesh,
+            precision=self.precision, max_batch=base_engine.max_batch,
+            buckets=base_engine.buckets,
+            fsdp_min_bytes=(
+                self.fsdp_min_bytes if self.fsdp_min_bytes is not None
+                else FSDP_MIN_BYTES
+            ),
+        )
+
+    def _place(self, engine, params) -> t.Tuple[t.Any, int]:
+        return engine.place_params(params)
+
 
 class _Replica:
     __slots__ = ("index", "device", "registry", "batcher", "dispatched")
@@ -181,6 +261,19 @@ class EngineFleet:
     (tests pin replicas to forced CPU devices) or an int to take the
     first N. ``capacity`` is fleet-wide: the bound applies to the SUM
     of replica queues, checked atomically with routing.
+
+    ``submesh=(tp, fsdp)`` switches the fleet to **sub-mesh replicas**
+    (docs/SERVING.md "Sharded serving & precision tiers"): the device
+    list is partitioned into disjoint ``tp*fsdp``-device groups, each
+    hosting ONE :class:`~torch_actor_critic_tpu.serve.sharded.
+    ShardedPolicyEngine` with GSPMD-sharded params — the route to
+    serving a model too big for a single chip's HBM. ``precision``
+    picks the numeric tier (``f32`` bitwise-pinned / ``bf16`` /
+    ``int8`` weight-quantized); a non-f32 tier without an explicit
+    submesh runs on ``(1, 1)`` sub-meshes (one device each, sharded
+    machinery engaged for the tier alone). Admission, least-loaded
+    scoring, breakers and continuous batching are UNCHANGED — a
+    sub-mesh is just a wider replica.
     """
 
     def __init__(
@@ -194,18 +287,32 @@ class EngineFleet:
         capacity: int = 1024,
         span_log=None,
         mode: str = "continuous",
+        submesh: t.Tuple[int, int] | None = None,
+        precision: str = "f32",
+        fsdp_min_bytes: int | None = None,
     ):
         if isinstance(devices, int):
             devices = jax.local_devices()[:devices]
         devices = list(devices if devices is not None else jax.local_devices())
         if not devices:
             raise ValueError("EngineFleet needs at least one device")
+        if precision not in ("f32", "bf16", "int8"):
+            raise ValueError(
+                f"precision must be f32/bf16/int8, got {precision!r}"
+            )
         self.registry = registry
         self.capacity = int(capacity)
         self.max_batch = int(max_batch)
         self.mode = mode
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.span_log = span_log
+        self.precision = precision
+        self.submesh = tuple(submesh) if submesh is not None else None
+        if self.submesh is None and precision != "f32":
+            # A precision tier is a sharded-engine feature; (1,1)
+            # sub-meshes give every device the tier without changing
+            # the replica count.
+            self.submesh = (1, 1)
         self._lock = threading.Lock()
         self._rr = 0  # round-robin cursor for idle ties; guarded-by: _lock
         self._running = True  # guarded-by: _lock
@@ -213,14 +320,36 @@ class EngineFleet:
         # (replica-internal state has its own locks), so reads are safe
         # anywhere.
         self._replicas = []
-        for i, dev in enumerate(devices):
-            view = _ReplicaRegistry(registry, dev, i)
-            batcher = MicroBatcher(
-                view, max_batch=max_batch, max_wait_ms=max_wait_ms,
-                metrics=self.metrics, seed=seed * 7919 + i,
-                capacity=capacity, span_log=span_log, mode=mode,
+        if self.submesh is not None:
+            from torch_actor_critic_tpu.parallel.sharding import (
+                partition_submeshes,
             )
-            self._replicas.append(_Replica(i, dev, view, batcher))
+
+            tp, fsdp = self.submesh
+            meshes = partition_submeshes(devices, tp, fsdp)
+            self.metrics.cost_prefix = "serve/sharded_forward"
+            for i, mesh in enumerate(meshes):
+                view = _SubmeshReplicaRegistry(
+                    registry, mesh, i, precision=precision,
+                    fsdp_min_bytes=fsdp_min_bytes, metrics=self.metrics,
+                )
+                batcher = MicroBatcher(
+                    view, max_batch=max_batch, max_wait_ms=max_wait_ms,
+                    metrics=self.metrics, seed=seed * 7919 + i,
+                    capacity=capacity, span_log=span_log, mode=mode,
+                )
+                self._replicas.append(_Replica(i, mesh, view, batcher))
+        else:
+            for i, dev in enumerate(devices):
+                view = _ReplicaRegistry(
+                    registry, dev, i, metrics=self.metrics
+                )
+                batcher = MicroBatcher(
+                    view, max_batch=max_batch, max_wait_ms=max_wait_ms,
+                    metrics=self.metrics, seed=seed * 7919 + i,
+                    capacity=capacity, span_log=span_log, mode=mode,
+                )
+                self._replicas.append(_Replica(i, dev, view, batcher))
 
     @property
     def n_replicas(self) -> int:
@@ -351,9 +480,14 @@ class EngineFleet:
             reps = list(self._replicas)
         for rep in reps:
             ema = rep.batcher.ema_row_s
+            device = rep.device
+            if hasattr(device, "devices"):  # a sub-mesh replica
+                device = ",".join(
+                    str(d) for d in device.devices.flatten()
+                )
             out.append({
                 "replica": rep.index,
-                "device": str(rep.device),
+                "device": str(device),
                 "queue_depth": rep.batcher.queue_depth(),
                 "load_rows": rep.batcher.load_rows(),
                 "ema_row_s": round(ema, 6) if ema is not None else None,
@@ -364,6 +498,31 @@ class EngineFleet:
                 },
             })
         return out
+
+    def sharding_stats(self) -> dict | None:
+        """The ``/metrics`` ``sharding`` section: sub-mesh shape,
+        precision tier and per-replica params-transfer accounting
+        (bytes actually moved at the last reload + lifetime totals).
+        ``None`` for a plain per-device fleet — the section only
+        appears when sub-mesh serving is on."""
+        if self.submesh is None:
+            return None
+        tp, fsdp = self.submesh
+        per_replica = []
+        for rep in self._replicas:
+            entry = {"replica": rep.index}
+            entry.update(rep.registry.transfer_stats())
+            entry["devices"] = [
+                str(d) for d in rep.device.devices.flatten()
+            ]
+            per_replica.append(entry)
+        return {
+            "submesh": {"tp": tp, "fsdp": fsdp},
+            "devices_per_replica": tp * fsdp,
+            "replicas": len(self._replicas),
+            "precision": self.precision,
+            "per_replica": per_replica,
+        }
 
     def compile_stats(self) -> dict:
         """Per-replica engine compile accounting (the fleet twin of

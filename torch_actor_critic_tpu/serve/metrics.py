@@ -31,6 +31,7 @@ _SUM_KEYS = (
     "requests_total", "responses_total", "errors_total", "batches_total",
     "queue_depth", "sheds_total", "shed_expired_total",
     "compiles_total", "live_compiles",
+    "reload_transfer_bytes_total", "param_placements_total",
 )
 
 
@@ -65,6 +66,17 @@ class ServeMetrics:
             {}
         )  # guarded-by: _lock
         self._peaks = None  # costmodel.Peaks, lazy; guarded-by: _lock
+        # Params-placement accounting (sub-mesh serving,
+        # docs/SERVING.md "Sharded serving & precision tiers"): bytes
+        # actually moved by generation-/precision-keyed device_puts —
+        # the counter the one-transfer-per-device hot-reload contract
+        # is asserted against.
+        self.reload_transfer_bytes_total = 0  # guarded-by: _lock
+        self.param_placements_total = 0  # guarded-by: _lock
+        # Which registered jit identity cost_snapshot resolves bucket
+        # programs under; the sub-mesh fleet flips this to its own
+        # entry point ("serve/sharded_forward").
+        self.cost_prefix = "serve/forward"
 
     # ----------------------------------------------------------- recording
 
@@ -104,6 +116,13 @@ class ServeMetrics:
                 self.shed_by_reason.get(reason, 0) + 1
             )
 
+    def record_transfer(self, nbytes: int):
+        """One params placement (a replica's generation- or
+        precision-keyed ``device_put``) of ``nbytes`` actual bytes."""
+        with self._lock:
+            self.reload_transfer_bytes_total += int(nbytes)
+            self.param_placements_total += 1
+
     def record_expired(self, n: int = 1):
         """Accepted requests purged at group-collection time because
         their deadline passed while queued — never dispatched."""
@@ -139,7 +158,7 @@ class ServeMetrics:
         registry = get_cost_registry()
         out: t.Dict[str, t.Any] = {}
         for b, agg in sorted(buckets.items()):
-            cost = registry.get(f"serve/forward[b{b}]")
+            cost = registry.get(f"{self.cost_prefix}[b{b}]")
             if cost is None or agg["total_s"] <= 0.0:
                 continue
             entry = roofline(
@@ -170,6 +189,10 @@ class ServeMetrics:
                 "sheds_total": self.sheds_total,
                 "shed_by_reason": dict(self.shed_by_reason),
                 "shed_expired_total": self.shed_expired_total,
+                "reload_transfer_bytes_total": (
+                    self.reload_transfer_bytes_total
+                ),
+                "param_placements_total": self.param_placements_total,
                 "uptime_s": round(lifetime_s, 3),
                 # Occupancy: real rows per dispatched row slot — 1.0
                 # means every forward ran a full bucket, low values mean

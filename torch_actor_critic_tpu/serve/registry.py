@@ -77,7 +77,16 @@ class ModelRegistry:
         reload_retries: int = 1,
         reload_retry_backoff_s: float = 0.5,
         sleep: t.Callable[[float], None] = time.sleep,
+        restore_shardings: t.Callable[[t.Any], t.Any] | None = None,
     ):
+        # Direct-to-sharded checkpoint restore (sub-mesh serving,
+        # docs/SERVING.md "Sharded serving & precision tiers"): a
+        # callable (abstract actor-params tree -> Sharding tree) handed
+        # to Checkpointer.restore_actor_params so Orbax lands every
+        # array in its NamedSharding layout — no host-RAM gather of a
+        # model that may not fit one host. Applied at registration and
+        # on every hot-reload.
+        self._restore_shardings = restore_shardings
         self._slots: t.Dict[str, _Slot] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._poller: threading.Thread | None = None  # guarded-by: _lock
@@ -145,7 +154,9 @@ class ModelRegistry:
             from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
 
             checkpointer = Checkpointer(ckpt_dir, save_buffer=False)
-            params, meta = checkpointer.restore_actor_params()
+            params, meta = checkpointer.restore_actor_params(
+                shardings=self._restore_shardings
+            )
             epoch = meta["epoch"]
         # A slot must never go live on poisoned weights: the same
         # sentinel that validates every hot-reload validates the
@@ -364,7 +375,9 @@ class ModelRegistry:
             # Restore OUTSIDE the slot lock: a multi-second Orbax
             # read must not stall acquire() (live traffic keeps
             # flowing on the old params until the swap below).
-            return latest, slot.checkpointer.restore_actor_params(latest)
+            return latest, slot.checkpointer.restore_actor_params(
+                latest, shardings=self._restore_shardings
+            )
 
         try:
             out = call_with_retries(

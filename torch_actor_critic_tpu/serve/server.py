@@ -341,6 +341,9 @@ class PolicyServer:
         span_log=None,
         mode: str = "continuous",
         devices: t.Sequence | int | None = None,
+        submesh: t.Tuple[int, int] | None = None,
+        precision: str = "f32",
+        fsdp_min_bytes: int | None = None,
     ):
         self.registry = registry
         # Per-request trace spans (telemetry.traceview.RequestSpanLog):
@@ -365,9 +368,13 @@ class PolicyServer:
         # > 1 or an explicit device list builds an EngineFleet — one
         # engine replica per device behind this server's one admission
         # layer (serve/fleet.py). The fleet duck-types the batcher
-        # surface, so everything downstream is unchanged.
-        if devices is not None and not (
-            isinstance(devices, int) and devices <= 1
+        # surface, so everything downstream is unchanged. A submesh or
+        # non-f32 precision always takes the fleet path (sub-mesh
+        # replicas, serve/sharded.py) — even with one replica.
+        if submesh is not None or precision != "f32" or (
+            devices is not None and not (
+                isinstance(devices, int) and devices <= 1
+            )
         ):
             from torch_actor_critic_tpu.serve.fleet import EngineFleet
 
@@ -375,7 +382,8 @@ class PolicyServer:
                 registry, devices=devices, max_batch=max_batch,
                 max_wait_ms=max_wait_ms, metrics=self.metrics,
                 seed=seed, capacity=capacity, span_log=span_log,
-                mode=mode,
+                mode=mode, submesh=submesh, precision=precision,
+                fsdp_min_bytes=fsdp_min_bytes,
             )
             self.batcher.warmup()
         else:
@@ -463,6 +471,13 @@ class PolicyServer:
                             "replicas": server.batcher.replica_stats(),
                             "compiles": server.batcher.compile_stats(),
                         }
+                    # Sub-mesh serving view (serve/sharded.py):
+                    # sub-mesh shape, precision tier, per-replica
+                    # params-transfer bytes on reload.
+                    if hasattr(server.batcher, "sharding_stats"):
+                        sharding = server.batcher.sharding_stats()
+                        if sharding is not None:
+                            snap["sharding"] = sharding
                     # Per-bucket live roofline: registered program
                     # FLOPs/bytes over measured forward time
                     # (docs/OBSERVABILITY.md "Cost attribution").

@@ -1,0 +1,331 @@
+"""GSPMD sub-mesh serving: one policy replica sharded over N devices.
+
+The serving plane scaled OUT (engine-per-device fleet, PR 9 worker
+processes) but never UP: every replica held the full params pytree on
+one chip, capping the servable model at a single chip's HBM. This
+module is the Sebulba move (Podracer, arXiv:2104.06272) applied to
+inference — carve the device topology into disjoint ``(tp, fsdp)``
+**sub-meshes**, each hosting ONE sharded model copy, and let the fleet
+dispatch across sub-meshes exactly as it dispatched across single
+devices.
+
+:class:`ShardedPolicyEngine` keeps the entire
+:class:`~torch_actor_critic_tpu.serve.engine.PolicyEngine` contract —
+bucketed jit cache, warmup, in-graph all-finite flag, compile
+accounting — and changes only the program and the params layout:
+
+- **At rest, params are sharded** over the sub-mesh by the SAME
+  ``param_specs`` (tp role + size-thresholded fsdp) the training side
+  uses (:mod:`torch_actor_critic_tpu.parallel.sharding`): each device
+  holds ``1/(tp*fsdp)`` of every qualifying array. That is the HBM
+  budget win — the model only ever needs to FIT sharded.
+- **The f32 tier is bitwise-pinned** to the single-device engine: the
+  jitted forward first constrains every param leaf back to replicated
+  (GSPMD materializes the all-gathers over sub-mesh ICI), then runs
+  the identical apply — all compute operands replicated means the
+  identical scalar program, so responses agree bit-for-bit with
+  ``PolicyEngine`` (pinned by tests/test_serve_sharded.py). Exactness
+  is the compat contract; the gathers are the price.
+- **The low-precision tiers keep the sharded layout through the
+  compute**: ``bf16`` rebuilds the actor at the MXU's native matmul
+  width (the PR-12 ``compute_dtype`` policy — params stay f32 at
+  rest, casts happen in-graph); ``int8`` serves weight-quantized
+  params (per-channel symmetric scales computed ONCE at
+  register/reload time, dequant-in-graph) so the weight stream costs
+  a quarter of the HBM bandwidth. Both let the GSPMD partitioner run
+  genuinely tensor-parallel matmuls — reduction order differs from
+  the single-device engine in the last bits, which these tiers
+  already concede by construction.
+
+Hot-reload stays one-transfer-per-device: the fleet's sub-mesh replica
+view performs a generation-keyed **sharded** ``device_put`` (each
+device receives exactly its shards), cached on ``(generation,
+precision)`` so a tier change invalidates stale-dtype placements, and
+every placement's actual bytes land on the transfer counter
+(``/metrics`` ``sharding``). Provable on CPU with the forced
+multi-device shim (tests/conftest.py): XLA partitions for virtual
+host devices exactly as for chips (docs/SERVING.md "Sharded serving &
+precision tiers").
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torch_actor_critic_tpu.parallel.sharding import (
+    FSDP_MIN_BYTES,
+    make_submesh,
+    param_specs,
+    partition_submeshes,
+)
+from torch_actor_critic_tpu.serve.engine import PolicyEngine
+
+__all__ = [
+    "Int8Param",
+    "PRECISIONS",
+    "ShardedPolicyEngine",
+    "dequantize_params",
+    "make_submesh",
+    "partition_submeshes",
+    "quantize_params",
+]
+
+PRECISIONS = ("f32", "bf16", "int8")
+
+
+class Int8Param(t.NamedTuple):
+    """One weight-quantized parameter: ``q`` is the int8 tensor (the
+    original kernel's shape), ``scale`` the per-output-channel f32
+    symmetric scales (last-dim length). Dequantized in-graph as
+    ``q.astype(f32) * scale``; a NamedTuple so it IS a pytree — jit,
+    ``device_put`` with per-leaf shardings and checkpoint-free reload
+    all traverse it like any other params subtree."""
+
+    q: t.Any
+    scale: t.Any
+
+
+def _quantizable(leaf) -> bool:
+    """Weight-only int8 quantizes 2-D+ float arrays (the matmul
+    kernels, where the bandwidth lives); biases, scalars and integer
+    leaves stay f32 — they are noise in the weight stream and
+    precision-critical in the epilogue."""
+    dt = getattr(leaf, "dtype", None)
+    return (
+        hasattr(leaf, "ndim") and leaf.ndim >= 2
+        and dt is not None and jnp.issubdtype(dt, jnp.floating)
+    )
+
+
+def quantize_params(params: t.Any) -> t.Any:
+    """Per-channel symmetric int8 weight quantization, host-side.
+
+    Runs at register/reload time — NEVER per request. For each
+    quantizable leaf ``W`` the per-output-channel scale is
+    ``max|W[..., c]| / 127`` (zero-max channels get a tiny floor so
+    the scale never divides by zero), and ``q = round(W / scale)``
+    clipped to int8. The round-trip error is bounded elementwise by
+    ``scale / 2`` (pinned by tests/test_serve_sharded.py)."""
+
+    def one(leaf):
+        if not _quantizable(leaf):
+            return leaf
+        w = np.asarray(leaf, dtype=np.float32)
+        amax = np.abs(w).max(axis=tuple(range(w.ndim - 1)))
+        scale = np.maximum(amax, 1e-12) / 127.0
+        q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+        return Int8Param(q=q, scale=scale.astype(np.float32))
+
+    return jax.tree_util.tree_map(one, params)
+
+
+def dequantize_params(params: t.Any, dtype=jnp.float32) -> t.Any:
+    """In-graph inverse of :func:`quantize_params`: ``q * scale`` back
+    to ``dtype``, leaving unquantized leaves alone. Traceable — this
+    is the first op of the int8 tier's jitted forward, so the weights
+    cross HBM as int8 and widen on-chip."""
+
+    def one(leaf):
+        if isinstance(leaf, Int8Param):
+            return leaf.q.astype(dtype) * leaf.scale.astype(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(
+        one, params, is_leaf=lambda x: isinstance(x, Int8Param)
+    )
+
+
+class ShardedPolicyEngine(PolicyEngine):
+    """:class:`PolicyEngine` whose forward runs jit-with-sharding over
+    a ``(tp, fsdp)`` sub-mesh, with a precision tier.
+
+    ``mesh`` must be a 2-axis ``(tp, fsdp)`` Mesh
+    (:func:`~torch_actor_critic_tpu.parallel.sharding.make_submesh`).
+    ``precision`` picks the tier (module docstring); ``fsdp_min_bytes``
+    is the at-rest sharding threshold (tests pass 0 so tiny models
+    actually shard). Params handed to :meth:`act` must have gone
+    through :meth:`place_params` (the fleet's replica view does this,
+    generation-keyed); the engine itself is stateless about them.
+    """
+
+    TRACE_PREFIX = "serve/sharded_forward"
+
+    def __init__(
+        self,
+        actor_def,
+        obs_spec: t.Any,
+        mesh: Mesh,
+        precision: str = "f32",
+        max_batch: int = 64,
+        buckets: t.Sequence[int] | None = None,
+        fsdp_min_bytes: int = FSDP_MIN_BYTES,
+    ):
+        if tuple(mesh.axis_names) != ("tp", "fsdp"):
+            raise ValueError(
+                f"ShardedPolicyEngine needs a (tp, fsdp) sub-mesh "
+                f"(parallel.sharding.make_submesh), got axes "
+                f"{mesh.axis_names}"
+            )
+        if precision not in PRECISIONS:
+            raise ValueError(
+                f"precision must be one of {PRECISIONS}, got {precision!r}"
+            )
+        self.mesh = mesh
+        self._precision = precision
+        self.fsdp_min_bytes = int(fsdp_min_bytes)
+        self._replicated = NamedSharding(mesh, P())
+        super().__init__(
+            actor_def, obs_spec, max_batch=max_batch, buckets=buckets
+        )
+
+    @property
+    def precision(self) -> str:
+        return self._precision
+
+    def _cost_devices(self) -> int:
+        # Per-chip cost registration: the lowered analysis covers the
+        # whole logical program; dividing by the sub-mesh size keeps
+        # roofline/MFU comparable with every other entry point
+        # (docs/OBSERVABILITY.md "Cost attribution").
+        return int(self.mesh.size)
+
+    def _build_forwards(self) -> None:
+        replicated = self._replicated
+        precision = self._precision
+        # bf16 tier = the PR-12 compute_dtype policy applied to
+        # serving: rebuild the actor at bf16 matmul width (params stay
+        # f32 at rest; the module casts in-graph and its heads return
+        # f32 — distribution math is precision-sensitive).
+        if precision == "bf16":
+            if not hasattr(self.actor_def, "dtype"):
+                raise ValueError(
+                    f"{type(self.actor_def).__name__} has no compute-"
+                    "dtype knob; the bf16 serving tier needs a model "
+                    "built with the PR-12 compute_dtype policy"
+                )
+            apply_def = self.actor_def.clone(dtype=jnp.bfloat16)
+        else:
+            apply_def = self.actor_def
+
+        def materialize(params):
+            """The tier's in-graph params story. int8: dequantize (the
+            weights crossed HBM as int8). f32: constrain every leaf
+            back to replicated BEFORE any compute — all-gather over
+            sub-mesh ICI — which pins the tier bitwise to the
+            single-device engine (identical scalar program on every
+            device). bf16/int8 keep the at-rest sharded layout and let
+            the partitioner run real tensor-parallel matmuls."""
+            if precision == "int8":
+                return dequantize_params(params)
+            if precision == "f32":
+                return jax.tree_util.tree_map(
+                    lambda x: x
+                    if jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key)
+                    else jax.lax.with_sharding_constraint(x, replicated),
+                    params,
+                )
+            return params
+
+        donate = jax.default_backend() not in ("cpu",)
+
+        def fwd_sampled(params, obs, key):
+            action, _ = apply_def.apply(
+                materialize(params), obs, key,
+                deterministic=False, with_logprob=False,
+            )
+            action = jax.lax.with_sharding_constraint(action, replicated)
+            return action, jnp.all(jnp.isfinite(action))
+
+        def fwd_deterministic(params, obs):
+            action, _ = apply_def.apply(
+                materialize(params), obs, None,
+                deterministic=True, with_logprob=False,
+            )
+            action = jax.lax.with_sharding_constraint(action, replicated)
+            return action, jnp.all(jnp.isfinite(action))
+
+        self._fwd = {
+            True: jax.jit(
+                fwd_deterministic, donate_argnums=(1,) if donate else ()
+            ),
+            False: jax.jit(
+                fwd_sampled, donate_argnums=(1,) if donate else ()
+            ),
+        }
+
+    # ------------------------------------------------------ params layout
+
+    def param_shardings(self, params: t.Any) -> t.Any:
+        """The at-rest :class:`NamedSharding` tree for ``params``
+        (PRE-quantization shapes): training's ``param_specs`` over this
+        sub-mesh. Structurally matches :meth:`prepare_params` output —
+        a quantized kernel's ``q`` inherits the kernel's spec (same
+        shape, 4x fewer bytes), its ``scale`` replicates."""
+        specs = param_specs(params, self.mesh, self.fsdp_min_bytes)
+        if self._precision == "int8":
+            specs = jax.tree_util.tree_map(
+                lambda leaf, s: Int8Param(q=s, scale=P())
+                if _quantizable(leaf) else s,
+                params, specs,
+            )
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def prepare_params(self, params: t.Any) -> t.Any:
+        """Tier-specific host-side transform, run once per
+        register/reload: int8 quantizes; f32/bf16 pass through (bf16
+        keeps f32 master weights at rest — the in-graph cast is free
+        on the MXU and a bf16 at-rest copy would double placements
+        on a tier flip)."""
+        if self._precision == "int8":
+            return quantize_params(params)
+        return params
+
+    def place_params(self, params: t.Any) -> t.Tuple[t.Any, int]:
+        """Prepare + shard-place ``params`` on the sub-mesh; returns
+        ``(placed, transferred_bytes)``. One ``device_put`` per leaf
+        moves exactly each device's shards — a sharded leaf costs its
+        logical bytes total across the sub-mesh, a replicated leaf
+        costs ``bytes * mesh.size``; the sum is the per-replica
+        hot-reload transfer the ``/metrics`` ``sharding`` section
+        reports."""
+        shardings = self.param_shardings(params)
+        prepared = self.prepare_params(params)
+        placed = jax.tree_util.tree_map(
+            jax.device_put, prepared, shardings
+        )
+        transferred = int(sum(
+            sum(s.data.nbytes for s in leaf.addressable_shards)
+            for leaf in jax.tree_util.tree_leaves(placed)
+        ))
+        return placed, transferred
+
+    # ------------------------------------------------------- input staging
+
+    def _device_obs(self, padded):
+        # Committed-replicated placement: the jit sees every input with
+        # an explicit sub-mesh sharding (params committed sharded, obs/
+        # key committed replicated), so partitioning never guesses.
+        return jax.device_put(padded, self._replicated)
+
+    def _device_key(self, key):
+        return jax.device_put(key, self._replicated)
+
+    def replicate(self) -> "ShardedPolicyEngine":
+        """A fresh engine with this configuration (same sub-mesh, same
+        tier) and an empty jit cache — mirrors the base contract; the
+        fleet builds per-sub-mesh engines itself, each on its OWN
+        mesh."""
+        return ShardedPolicyEngine(
+            self.actor_def, self.obs_spec, self.mesh,
+            precision=self._precision, max_batch=self.max_batch,
+            buckets=self.buckets, fsdp_min_bytes=self.fsdp_min_bytes,
+        )

@@ -355,7 +355,7 @@ class Checkpointer:
         return train_state, out.get("buffer"), dict(out["meta"]), arrays
 
     def restore_actor_params(
-        self, epoch: int | None = None
+        self, epoch: int | None = None, shardings: t.Any = None
     ) -> t.Tuple[t.Any, dict]:
         """``(actor_params, meta)`` of a checkpoint — the serving path.
 
@@ -368,6 +368,18 @@ class Checkpointer:
         back as a plain nested dict, which is exactly what
         ``actor_def.apply`` takes.
 
+        ``shardings`` is the sub-mesh serving path (docs/SERVING.md
+        "Sharded serving & precision tiers"): a callable taking the
+        actor-params abstract tree (``ShapeDtypeStruct`` leaves, built
+        from the checkpoint's OWN metadata — still no caller-side
+        abstract tree) and returning a matching
+        :class:`jax.sharding.Sharding` tree, or that sharding tree
+        directly. Orbax then restores every actor array **straight
+        into its sharded layout** — each device reads exactly its
+        shards, and no host-RAM copy of the full (possibly
+        bigger-than-one-host) actor is ever materialized. Non-actor
+        subtrees restore as before.
+
         As with :meth:`restore`, ``epoch=None`` falls back past corrupt
         newest steps (a serving replica must come up on the last good
         weights, not crash-loop on a half-written save).
@@ -376,7 +388,9 @@ class Checkpointer:
             last_err: Exception | None = None
             for step in self._valid_candidates():
                 try:
-                    return self.restore_actor_params(step)
+                    return self.restore_actor_params(
+                        step, shardings=shardings
+                    )
                 except Exception as e:  # noqa: BLE001 — corrupt step
                     logger.warning(
                         "actor restore from epoch %d under %s failed "
@@ -397,15 +411,37 @@ class Checkpointer:
         prev_level = absl_logger.level
         absl_logger.setLevel(_logging.ERROR)
         try:
+            restore_args = (
+                ocp.args.StandardRestore()
+                if shardings is None
+                else ocp.args.StandardRestore(
+                    self._sharded_abstract_state(epoch, shardings)
+                )
+            )
+
+            def _restore():
+                import warnings
+
+                with warnings.catch_warnings():
+                    # The non-actor subtrees carry no shardings on
+                    # purpose (only the actor is served); Orbax warns
+                    # per such leaf that it falls back to the sharding
+                    # file — noise for this deliberate partial layout.
+                    warnings.filterwarnings(
+                        "ignore",
+                        message=".*sharding info.*",
+                        category=UserWarning,
+                    )
+                    return self._mgr.restore(
+                        epoch,
+                        args=ocp.args.Composite(
+                            train_state=restore_args,
+                            meta=ocp.args.JsonRestore(),
+                        ),
+                    )
+
             out = self._retry(
-                lambda: self._mgr.restore(
-                    epoch,
-                    args=ocp.args.Composite(
-                        train_state=ocp.args.StandardRestore(),
-                        meta=ocp.args.JsonRestore(),
-                    ),
-                ),
-                what=f"actor restore (epoch {epoch})",
+                _restore, what=f"actor restore (epoch {epoch})"
             )
         finally:
             absl_logger.setLevel(prev_level)
@@ -416,6 +452,53 @@ class Checkpointer:
                 "actor_params item — not a TrainState checkpoint?"
             )
         return train_state["actor_params"], dict(out["meta"], epoch=epoch)
+
+    def _sharded_abstract_state(self, epoch: int, shardings: t.Any):
+        """Abstract ``train_state`` tree for a direct-to-sharded actor
+        restore, built from the checkpoint's OWN array metadata (so
+        serving still needs no caller-side abstract tree): the
+        ``actor_params`` subtree carries the requested shardings,
+        every other subtree restores unconstrained. Orbax cannot
+        partially restore a ``StandardSave`` item, so the full tree is
+        described — but only the actor arrays get layouts; the rest
+        land exactly as the plain shape-from-disk path lands them."""
+        ts_meta = self._retry(
+            lambda: self._mgr.item_metadata(epoch),
+            what=f"checkpoint array-metadata read (epoch {epoch})",
+        )["train_state"]
+        if ts_meta is None:
+            # A manager that never SAVED this item (the serving
+            # process — the trainer wrote the checkpoint) has no
+            # handler registered for it and reports None; read the
+            # item's array metadata straight off its directory.
+            from etils import epath
+
+            ts_meta = self._retry(
+                lambda: ocp.StandardCheckpointHandler().metadata(
+                    epath.Path(self.directory) / str(epoch) / "train_state"
+                ),
+                what=f"checkpoint array-metadata read (epoch {epoch})",
+            )
+        if ts_meta is None or "actor_params" not in ts_meta:
+            raise KeyError(
+                f"checkpoint at {self.directory} epoch {epoch} has no "
+                "actor_params item — not a TrainState checkpoint?"
+            )
+
+        def sds(m, sharding=None):
+            return jax.ShapeDtypeStruct(
+                tuple(m.shape), m.dtype, sharding=sharding
+            )
+
+        abstract = {
+            k: jax.tree_util.tree_map(sds, v) for k, v in ts_meta.items()
+        }
+        if callable(shardings):
+            shardings = shardings(abstract["actor_params"])
+        abstract["actor_params"] = jax.tree_util.tree_map(
+            sds, ts_meta["actor_params"], shardings
+        )
+        return abstract
 
     def refresh(self) -> None:
         """Re-read the checkpoint directory. The manager caches its
