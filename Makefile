@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke shard-serve-smoke decouple-smoke visual-smoke scenario-smoke
+.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke shard-serve-smoke decouple-smoke visual-smoke scenario-smoke sanitize-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -145,6 +145,16 @@ decouple-smoke:
 visual-smoke:
 	JAX_PLATFORMS=cpu python scripts/visual_smoke.py
 
+# Transfer-sanitizer smoke (forced 4-device CPU, real CLIs): a short
+# train and a 60-request serve flood both run CLEAN under --sanitize
+# on (train loss stream bitwise == off), while an injected host read
+# (numpy chunk into the guarded burst; numpy params into the guarded
+# forward) trips jax.transfer_guard("disallow") loudly on each plane
+# (docs/ANALYSIS.md "Runtime sanitizers"). The script forces the
+# device count itself before importing jax.
+sanitize-smoke:
+	python scripts/sanitize_smoke.py
+
 # Scenario-workloads smoke (CPU, real CLI): every scenarios/ pillar —
 # multi-agent (per-agent reward curves), procedural (fresh level per
 # episode, finite returns), multi-task (schema-valid per-task metrics
@@ -158,11 +168,14 @@ dryrun:
 		python __graft_entry__.py 8
 
 # tac-lint: the codebase-native static pass (docs/ANALYSIS.md) —
-# jit-hygiene, recompile-risk, lock-discipline, convention lints.
-# Nonzero exit on any finding; also wired into tier-1 via
-# tests/test_analysis.py's whole-package clean-run test.
+# jit-hygiene, recompile-risk, lock-discipline, convention lints plus
+# the dataflow families (donation-safety, prng-discipline,
+# contract-drift). --json is the machine contract: one JSON object CI
+# can diff, stable per-family exit codes (0 clean, 10..17 per family,
+# 1 mixed). Also wired into tier-1 via tests/test_analysis.py's
+# whole-package clean-run test.
 lint:
-	python -m torch_actor_critic_tpu.analysis torch_actor_critic_tpu scripts
+	python -m torch_actor_critic_tpu.analysis --json torch_actor_critic_tpu scripts
 
 native:
 	$(MAKE) -C torch_actor_critic_tpu/native

@@ -2041,6 +2041,68 @@ def bench_telemetry_overhead(budget_s=420.0):
     return out
 
 
+def bench_sanitize_overhead(budget_s=420.0):
+    """Transfer-sanitizer cost (docs/ANALYSIS.md "Runtime sanitizers"):
+    steady-state Trainer throughput with --sanitize off vs on at the
+    tiny CPU config. The off tier must be free by construction (one
+    pointer check per guarded site); the on tier's entire cost is two
+    transfer-guard context entries per update window plus the explicit
+    drain fetch, so BOTH sides of the comparison are held to the same
+    5% bar the telemetry/diagnostics stages use."""
+    from torch_actor_critic_tpu.parallel import make_mesh
+    from torch_actor_critic_tpu.sac.trainer import Trainer
+    from torch_actor_critic_tpu.utils.config import SACConfig
+    from torch_actor_critic_tpu.utils.tracking import Tracker
+
+    import tempfile
+
+    t_start = time.time()
+    out: dict = {}
+    tiny = dict(
+        hidden_sizes=(32, 32), batch_size=32, epochs=4,
+        steps_per_epoch=400, start_steps=50, update_after=50,
+        update_every=50, buffer_size=5000, max_ep_len=200,
+        save_every=1000, sentinel=False,
+    )
+    # ABBA order, like the telemetry/diagnostics overhead stages: slow
+    # host drift cancels to first order.
+    rates: dict = {"off": [], "grad_off": [], "on": [], "grad_on": []}
+    for mode in ("off", "on", "on", "off"):
+        if time.time() - t_start > budget_s:
+            break
+        try:
+            root = tempfile.mkdtemp(prefix="bench_san_")
+            tracker = Tracker(experiment="bench", root=root)
+            tr = Trainer(
+                "Pendulum-v1", SACConfig(**tiny, sanitize=mode),
+                mesh=make_mesh(dp=1), tracker=tracker,
+            )
+            try:
+                tr.train()
+            finally:
+                tr.close()
+            rows = tracker.metrics()[1:]  # epoch 0 pays the compiles
+            rates[mode].extend(r["env_steps_per_sec"] for r in rows)
+            rates[f"grad_{mode}"].extend(
+                r["grad_steps_per_sec"] for r in rows
+            )
+        except Exception as e:  # noqa: BLE001 — per-run best effort
+            out.setdefault("errors", []).append(repr(e)[:200])
+    for mode in ("off", "on"):
+        if rates[mode]:
+            out[mode] = {
+                "env_steps_per_sec": round(max(rates[mode]), 1),
+                "grad_steps_per_sec": round(max(rates[f"grad_{mode}"]), 1),
+                "epoch_rates": [round(r, 1) for r in rates[mode]],
+            }
+    off = out.get("off", {}).get("env_steps_per_sec")
+    on = out.get("on", {}).get("env_steps_per_sec")
+    if off and on:
+        out["overhead_pct"] = round((off - on) / off * 100, 2)
+    log(f"sanitize overhead: {out}")
+    return out
+
+
 def bench_decoupled(budget_s=420.0, max_actor_lag=4):
     """Decoupled actor/learner cost at equal config (docs/RESILIENCE.md
     "Decoupled-plane failure modes"): steady-state env-steps/s and
@@ -2319,6 +2381,9 @@ _STAGES = {
     },
     "diagnostics_overhead": lambda: {
         "diagnostics_overhead": bench_diagnostics_overhead()
+    },
+    "sanitize_overhead": lambda: {
+        "sanitize_overhead": bench_sanitize_overhead()
     },
     "on_device": lambda: {"on_device": bench_on_device()},
     # scenarios/ families (multi-agent / procedural / multi-task)
@@ -2709,6 +2774,18 @@ def main():
     )
     if res and "error" in res:
         diagnostics.append({"diagnostics_stage_error": res.pop("error")})
+    if res:
+        out.update(res)
+
+    # 5e. Transfer-sanitizer overhead (--sanitize off vs on ABBA; the
+    # off tier must be free, docs/ANALYSIS.md "Runtime sanitizers") —
+    # host+dispatch cost, CPU-pinned like the other instrumentation
+    # stages.
+    res = run_stage_subprocess(
+        "sanitize_overhead", 600, diagnostics, platform="cpu"
+    )
+    if res and "error" in res:
+        diagnostics.append({"sanitize_stage_error": res.pop("error")})
     if res:
         out.update(res)
 

@@ -111,6 +111,14 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                      help="Checkpoint hot-reload poll seconds (0 = off)")
     srv.add_argument("--seed", type=int, default=0,
                      help="PRNG seed for sampled (non-deterministic) acting")
+    srv.add_argument("--sanitize", choices=("off", "on"), default="off",
+                     help="Runtime transfer sanitizer (docs/ANALYSIS.md): "
+                          "'on' runs every engine forward under "
+                          "jax.transfer_guard('disallow') with explicit "
+                          "input placement, so an implicit host<->device "
+                          "transfer on the hot path fails loudly instead "
+                          "of taxing every request; 'off' (default) "
+                          "leaves the serving path untouched")
     srv.add_argument("--request-timeout", type=float, default=30.0,
                      help="Per-connection socket timeout in seconds (a "
                           "stalled client frees its handler thread)")
@@ -401,6 +409,7 @@ def main(argv=None):
         reload_retries=args.reload_retries,
         reload_retry_backoff_s=args.reload_retry_backoff,
         restore_shardings=restore_shardings,
+        sanitize=args.sanitize == "on",
     )
     info = registry.register(
         "default", actor_def, obs_spec,

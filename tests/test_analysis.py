@@ -492,6 +492,353 @@ def test_suffix_reduction_mismatch():
     assert len(findings) == 1
 
 
+# -------------------------------------------------------- donation-safety
+
+
+def test_use_after_donation_table_method_flagged_rebind_clean():
+    # The DONATING_ENTRY_POINTS table holds any `.update_burst(...)`
+    # call site to the builder's donate_argnums=(0, 1) contract.
+    bad = lint_one("""
+        def run(dp, state, buffer, chunk, n):
+            out_state, out_buf, m = dp.update_burst(state, buffer, chunk, n)
+            return state, m
+    """)
+    assert rules_of(bad) == ["use-after-donation"]
+    good = lint_one("""
+        def run(dp, state, buffer, chunk, n):
+            state, buffer, m = dp.update_burst(state, buffer, chunk, n)
+            return state, m
+    """)
+    assert good == []
+
+
+def test_use_after_donation_self_attr_rebind_clean():
+    # The host Trainer's exact spelling: self.state/self.buffer donated
+    # and rebound by the same statement.
+    findings = lint_one("""
+        class T:
+            def step(self, chunk, n):
+                self.state, self.buffer, m = self.dp.update_burst(
+                    self.state, self.buffer, chunk, n
+                )
+                return m
+    """)
+    assert findings == []
+
+
+def test_use_after_donation_loop_carry():
+    # Donated inside a loop, never rebound in the body: iteration 2
+    # passes an already-donated buffer (the PR-1 bug shape, on the
+    # donation side).
+    findings = lint_one("""
+        def run(loop, state, buffer, envs, key, epochs):
+            for e in range(epochs):
+                out = loop.epoch(state, buffer, envs, key)
+            return out
+    """)
+    assert rules_of(findings) == ["use-after-donation"]
+    clean = lint_one("""
+        def run(loop, state, buffer, envs, key, epochs):
+            for e in range(epochs):
+                state, buffer, envs, key, m = loop.epoch(
+                    state, buffer, envs, key
+                )
+            return m
+    """)
+    assert clean == []
+
+
+def test_use_after_donation_conditional_and_dict_jit():
+    # The serving engine's dict-of-jits with CONDITIONAL donation
+    # (`(1,) if donate else ()` — donation happens on accelerators,
+    # exactly where the bug bites): reading the padded obs after the
+    # subscripted call is flagged; not reading it is clean.
+    bad = lint_one("""
+        import jax
+
+        def fwd(p, o):
+            return o
+
+        class E:
+            def build(self, donate):
+                self._fwd = {
+                    True: jax.jit(fwd, donate_argnums=(1,) if donate else ()),
+                    False: jax.jit(fwd, donate_argnums=(1,) if donate else ()),
+                }
+
+            def act(self, params, padded):
+                out = self._fwd[True](params, padded)
+                return out, padded
+    """)
+    assert rules_of(bad) == ["use-after-donation"]
+    good = lint_one("""
+        import jax
+
+        def fwd(p, o):
+            return o
+
+        class E:
+            def build(self, donate):
+                self._fwd = {
+                    True: jax.jit(fwd, donate_argnums=(1,) if donate else ()),
+                }
+
+            def act(self, params, padded):
+                out = self._fwd[True](params, padded)
+                return out
+    """)
+    assert good == []
+
+
+def test_use_after_donation_closure_capture():
+    # "captured afterwards" counts: a closure defined after the
+    # donating call keeps the dead buffer alive.
+    findings = lint_one("""
+        def run(dp, state, buffer, chunk, n):
+            new_state, new_buf, m = dp.update_burst(state, buffer, chunk, n)
+
+            def report():
+                return buffer.size
+
+            return new_state, report
+    """)
+    assert rules_of(findings) == ["use-after-donation"]
+
+
+def test_donation_traced_reads_are_not_donation_sites():
+    # dynamic_lr_step's shape: TRACED code reading traced values
+    # (state.hyperparams per update) never goes through a donating
+    # call site — donation analysis applies to host dispatch only.
+    findings = lint_one("""
+        import jax
+
+        def dynamic_lr_step(updates, opt_state, state):
+            lr = state.hyperparams["lr"]
+            scaled = jax.tree_util.tree_map(lambda u: u * lr, updates)
+            again = state.hyperparams["lr"]
+            return scaled, opt_state, again
+
+        step_j = jax.jit(dynamic_lr_step)
+    """)
+    assert findings == []
+
+
+def test_undonated_push_flagged_and_donated_clean():
+    bad = lint_one("""
+        import jax
+        from torch_actor_critic_tpu.buffer.replay import push
+
+        push_j = jax.jit(jax.vmap(push))
+    """)
+    assert rules_of(bad) == ["undonated-push"]
+    good = lint_one("""
+        import jax
+        from torch_actor_critic_tpu.buffer.replay import push
+
+        push_j = jax.jit(jax.vmap(push), donate_argnums=(0,))
+    """)
+    assert good == []
+    # A local function merely NAMED push is not the replay ring.
+    local = lint_one("""
+        import jax
+
+        def push(buf, chunk):
+            return buf
+
+        push_j = jax.jit(push)
+    """)
+    assert "undonated-push" not in rules_of(local)
+
+
+def test_stale_donation_table_on_package_runs():
+    # A "package" whose builder files are gone: every table row must
+    # fail loudly instead of the donation contract silently unchecking.
+    findings = lint_sources({
+        "torch_actor_critic_tpu/__init__.py": "",
+    })
+    assert "stale-donation-table" in rules_of(findings)
+
+
+# ------------------------------------------------------- prng-discipline
+
+
+def test_key_reuse_two_sinks():
+    findings = lint_one("""
+        import jax
+
+        def f(key, obs):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))
+            return a + b
+    """)
+    assert rules_of(findings) == ["key-reuse"]
+
+
+def test_key_split_nondestructive():
+    findings = lint_one("""
+        import jax
+
+        def f(key):
+            sub = jax.random.split(key, 2)
+            return jax.random.normal(key, (3,)), sub
+    """)
+    assert rules_of(findings) == ["key-split-nondestructive"]
+
+
+def test_key_loop_reuse_pr1_engine_regression():
+    # THE regression fixture: PR 1's engine warmup reused one key
+    # across every bucket's sampled call (donation then deleted the
+    # buffer — crash on TPU, silent stream reuse before that).
+    bug = lint_one("""
+        import jax
+
+        def warmup(act, params, obs, buckets):
+            key = jax.random.key(0)
+            for b in buckets:
+                act(params, obs, key)
+    """)
+    assert rules_of(bug) == ["key-loop-reuse"]
+    # The PR-1 review fix: a fresh subkey per sampled call.
+    fixed = lint_one("""
+        import jax
+
+        def warmup(act, params, obs, buckets):
+            key = jax.random.key(0)
+            for b in buckets:
+                key, sub = jax.random.split(key)
+                act(params, obs, sub)
+    """)
+    assert fixed == []
+
+
+def test_key_rules_false_positive_pins():
+    # The codebase's sanctioned idioms, pinned clean in one fixture:
+    # destructive split, fold_in decorrelation (twice, distinct data),
+    # metadata reads, key-array indexing, and struct carries.
+    findings = lint_one("""
+        import jax
+        import jax.numpy as jnp
+
+        def sound(key, state, dev):
+            key, sub = jax.random.split(key)
+            a = jax.random.normal(sub, (3,))
+            local = state.replace(rng=jax.random.fold_in(state.rng, dev))
+            out = state.replace(
+                rng=jax.random.fold_in(state.rng, jnp.uint32(7))
+            )
+            keys = jax.random.split(key, 4)
+            b = jax.random.normal(keys[0], (3,))
+            c = jax.random.normal(keys[1], (3,))
+            n = key.shape
+            return a, b, c, local, out, n
+    """)
+    assert findings == []
+
+
+def test_key_branch_exclusivity():
+    # OnDeviceLoop.init's shape: the same parent key split in an
+    # early-return arm and again after it — never in sequence.
+    findings = lint_one("""
+        import jax
+
+        def init(self, k_envs):
+            if self.mesh is None:
+                return jax.vmap(reset)(jax.random.split(k_envs, 4))
+            return jax.vmap(reset)(jax.random.split(k_envs, 8))
+    """)
+    assert findings == []
+
+
+def test_key_metadata_and_host_dict_keys_exempt():
+    # key_data/key_impl serialization reads are not sinks, and a host
+    # function's dict-iteration `key` never qualifies as a PRNG key.
+    findings = lint_one("""
+        import jax
+
+        def save(key):
+            raw = jax.random.key_data(key)
+            impl = jax.random.key_impl(key)
+            return raw, impl
+
+        def host(metrics):
+            out = {}
+            for key in metrics:
+                out[key] = metrics[key] + len(key)
+            return out
+    """)
+    assert findings == []
+
+
+# -------------------------------------------------------- contract-drift
+
+
+def test_contract_table_checked_on_package_runs():
+    # A "package" with none of the wiring files: every contract row
+    # fails loudly (identity bindings gone).
+    findings = lint_sources({
+        "torch_actor_critic_tpu/__init__.py": "",
+    })
+    assert "stale-contract" in rules_of(findings)
+
+
+def test_contract_rules_skip_partial_runs():
+    # A fixture/single-file run cannot tell missing wiring from
+    # un-linted wiring — no contract findings.
+    findings = lint_one("def f():\n    return 1\n")
+    assert not any(
+        f.rule in (
+            "stale-contract", "missing-watchdog-scope",
+            "missing-cost-registration", "incoherent-sharding",
+        )
+        for f in findings
+    )
+
+
+def test_contract_wiring_satisfiable_in_miniature():
+    # A miniature package with one row's full wiring present: the
+    # OTHER rows fail (their files are absent) but train/update_burst's
+    # scope+registration+sharding checks pass — proving the matchers
+    # accept the real spellings (attr identity, Call-receiver .source,
+    # hoisted-name register_jit, one-hop planner use).
+    findings = lint_sources({
+        "torch_actor_critic_tpu/__init__.py": "",
+        "torch_actor_critic_tpu/parallel/dp.py": (
+            "import jax\n"
+            "from torch_actor_critic_tpu.parallel.sharding import "
+            "param_specs\n"
+            "class DataParallelSAC:\n"
+            "    burst_cost_name = 'train/update_burst'\n"
+            "    def _state_shardings(self, state):\n"
+            "        return param_specs(state, self.mesh, 0)\n"
+            "    def _build_burst(self, n, state, buffer, chunk):\n"
+            "        sh = self._state_shardings(state)\n"
+            "        def burst(state, buffer, chunk):\n"
+            "            return state, buffer, {}\n"
+            "        return jax.jit(burst, donate_argnums=(0, 1))\n"
+        ),
+        "torch_actor_critic_tpu/sac/trainer.py": (
+            "from torch_actor_critic_tpu.diagnostics.watchdog import "
+            "get_watchdog\n"
+            "class Trainer:\n"
+            "    def train(self):\n"
+            "        with get_watchdog().source('train/update_burst'):\n"
+            "            pass\n"
+            "    def _note_epoch_cost(self, registry):\n"
+            "        name = self.dp.burst_cost_name\n"
+            "        registry.register_jit(name, None)\n"
+        ),
+    })
+    drifted = {
+        f.message.split("'")[1] for f in findings
+        if f.rule in (
+            "stale-contract", "missing-watchdog-scope",
+            "missing-cost-registration", "incoherent-sharding",
+        )
+    }
+    assert "train/update_burst" not in drifted
+    assert "serve/forward" in drifted  # its file is absent here
+
+
 # ----------------------------------------------------------- suppression
 
 
@@ -555,5 +902,67 @@ def test_rule_catalog_is_consistent():
     # issue names are all present.
     for family in (
         "jit-hygiene", "recompile-risk", "lock-discipline", "conventions",
+        "donation-safety", "prng-discipline", "contract-drift",
     ):
         assert RULE_FAMILIES[family]
+
+
+def test_donation_table_covers_entry_points():
+    # Every jit entry point's donation contract is table-checked, and
+    # the contract table mirrors ENTRY_POINTS exactly.
+    from torch_actor_critic_tpu.analysis.contracts import (
+        ENTRY_POINT_CONTRACTS,
+    )
+    from torch_actor_critic_tpu.analysis.donation import (
+        DONATING_ENTRY_POINTS,
+    )
+    from torch_actor_critic_tpu.analysis.reachability import ENTRY_POINTS
+
+    assert set(ENTRY_POINT_CONTRACTS) == set(ENTRY_POINTS)
+    # Donation rows cover every ENTRY_POINTS identity (plus the
+    # warmup-path push wrappers, which have no cost identity).
+    assert set(ENTRY_POINTS) <= set(DONATING_ENTRY_POINTS)
+
+
+# ------------------------------------------------------------- CLI (json)
+
+
+def test_json_mode_per_family_exit_codes(tmp_path, capsys):
+    import json
+
+    from torch_actor_critic_tpu.analysis.__main__ import (
+        FAMILY_EXIT_CODES,
+        main,
+    )
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f():\n    return 1\n")
+    assert main(["--json", str(clean)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["clean"] and out["exit_code"] == 0
+
+    conv = tmp_path / "conv.py"
+    conv.write_text("def f(xs=[]):\n    return xs\n")
+    rc = main(["--json", str(conv)])
+    assert rc == FAMILY_EXIT_CODES["conventions"] == 13
+    out = json.loads(capsys.readouterr().out)
+    assert out["families"]["conventions"] == 1
+    assert out["exit_code"] == rc
+
+    prng = tmp_path / "prng.py"
+    prng.write_text(
+        "import jax\n\n"
+        "def f(key):\n"
+        "    a = jax.random.normal(key, (3,))\n"
+        "    return a + jax.random.uniform(key, (3,))\n"
+    )
+    assert main(["--json", str(prng)]) == FAMILY_EXIT_CODES[
+        "prng-discipline"
+    ] == 15
+    capsys.readouterr()
+
+    # Mixed families -> the generic failure code 1.
+    rc = main(["--json", str(conv), str(prng)])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["exit_code"] == 1 and len(out["findings"]) == 2

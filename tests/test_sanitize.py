@@ -1,0 +1,134 @@
+"""--sanitize runtime transfer sanitizers (docs/ANALYSIS.md "Runtime
+sanitizers"): the off tier is pinned no-op parity (bitwise metric
+stream, identical schema), the on tier is behavior-neutral on clean
+paths and a HARD failure on injected implicit host<->device transfers,
+on both planes (trainer burst/drain, serving forward)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from torch_actor_critic_tpu.parallel import make_mesh
+from torch_actor_critic_tpu.sac.trainer import Trainer
+from torch_actor_critic_tpu.utils.config import SACConfig
+
+TINY = dict(
+    hidden_sizes=(16, 16), batch_size=16, epochs=1, steps_per_epoch=90,
+    start_steps=30, update_after=30, update_every=30, buffer_size=2000,
+    max_ep_len=100, save_every=1000, sentinel=False,
+)
+
+OBS_DIM, ACT_DIM = 5, 2
+
+
+def _train(tier, seed=11):
+    tr = Trainer(
+        "Pendulum-v1", SACConfig(**TINY, sanitize=tier),
+        mesh=make_mesh(dp=1), seed=seed,
+    )
+    try:
+        return tr.train()
+    finally:
+        tr.close()
+
+
+def test_config_validates_tier():
+    with pytest.raises(ValueError, match="sanitize"):
+        SACConfig(sanitize="loud")
+    assert SACConfig().sanitize == "off"
+
+
+def test_off_tier_is_noop_parity_and_on_is_bitwise_clean():
+    # Off (the default) is the historical dispatch path; on must be
+    # bitwise-equal to it on a clean run AND add no metric keys —
+    # the guard observes transfers, it never changes math.
+    off = _train("off")
+    on = _train("on")
+    assert set(off) == set(on)
+    for k in ("loss_q", "loss_pi", "reward"):
+        assert off[k] == on[k], (k, off[k], on[k])
+        assert np.isfinite(on[k])
+
+
+def test_guard_trips_on_injected_host_chunk(monkeypatch):
+    # The injected host read: the placed chunk left as raw numpy, so
+    # the guarded burst dispatch sees an implicit host->device
+    # transfer — a hard failure, not a silent per-window transfer tax.
+    import torch_actor_critic_tpu.sac.trainer as trmod
+
+    monkeypatch.setattr(
+        trmod, "shard_chunk_from_local", lambda chunk, mesh, sp=1: chunk
+    )
+    tr = Trainer(
+        "Pendulum-v1", SACConfig(**TINY, sanitize="on"),
+        mesh=make_mesh(dp=1), seed=11,
+    )
+    try:
+        with pytest.raises(Exception, match="(?i)transfer"):
+            tr.train()
+    finally:
+        tr.close()
+
+
+def _actor_and_params():
+    from torch_actor_critic_tpu.models import Actor
+
+    actor = Actor(act_dim=ACT_DIM, hidden_sizes=(16, 16))
+    params = actor.init(
+        jax.random.key(0), np.zeros((1, OBS_DIM), np.float32), None,
+        deterministic=True, with_logprob=False,
+    )
+    return actor, params
+
+
+def test_sanitized_engine_forward_clean_and_bitwise():
+    from torch_actor_critic_tpu.serve.engine import PolicyEngine
+
+    actor, params = _actor_and_params()
+    spec = jax.ShapeDtypeStruct((OBS_DIM,), np.float32)
+    params = jax.device_put(params)
+    obs = np.linspace(-1, 1, 3 * OBS_DIM, dtype=np.float32).reshape(
+        3, OBS_DIM
+    )
+    plain = PolicyEngine(actor, spec, max_batch=4).act(
+        params, obs, deterministic=True
+    )
+    sane = PolicyEngine(actor, spec, max_batch=4, sanitize=True).act(
+        params, obs, deterministic=True
+    )
+    np.testing.assert_array_equal(plain, sane)
+    # Sampled path (explicit key placement) answers too.
+    out = PolicyEngine(actor, spec, max_batch=4, sanitize=True).act(
+        params, obs, key=jax.random.key(3), deterministic=False
+    )
+    assert out.shape == (3, ACT_DIM) and np.isfinite(out).all()
+
+
+def test_sanitized_engine_trips_on_host_params():
+    from torch_actor_critic_tpu.serve.engine import PolicyEngine
+
+    actor, params = _actor_and_params()
+    spec = jax.ShapeDtypeStruct((OBS_DIM,), np.float32)
+    np_params = jax.tree_util.tree_map(np.asarray, params)
+    engine = PolicyEngine(actor, spec, max_batch=4, sanitize=True)
+    with pytest.raises(Exception, match="(?i)transfer"):
+        engine.act(
+            np_params, np.zeros((2, OBS_DIM), np.float32),
+            deterministic=True,
+        )
+
+
+def test_registry_and_replicate_carry_sanitize():
+    from torch_actor_critic_tpu.serve import ModelRegistry
+
+    actor, params = _actor_and_params()
+    spec = jax.ShapeDtypeStruct((OBS_DIM,), np.float32)
+    reg = ModelRegistry(sanitize=True)
+    reg.register(
+        "default", actor, spec, params=jax.device_put(params),
+        max_batch=4, warmup=False,
+    )
+    engine, _, _ = reg.acquire("default")
+    assert engine.sanitize
+    assert engine.replicate().sanitize

@@ -66,7 +66,16 @@ def _install_lazy_backend(monkeypatch):
     from torch_actor_critic_tpu.utils import sync
 
     monkeypatch.setattr(
-        sync, "jax", types.SimpleNamespace(Array=LazyBackendArray)
+        sync,
+        "jax",
+        types.SimpleNamespace(
+            Array=LazyBackendArray,
+            # drain fetches through the EXPLICIT transfer API (legal
+            # under the --sanitize transfer guard); on this backend a
+            # device_get is a value fetch like __array__ — it demands
+            # bytes, so it runs the producer.
+            device_get=lambda x: np.asarray(x),
+        ),
     )
     monkeypatch.setattr(
         sync,

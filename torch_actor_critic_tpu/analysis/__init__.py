@@ -21,9 +21,12 @@ import pathlib
 import typing as t
 
 from torch_actor_critic_tpu.analysis import (
+    contracts,
     conventions,
+    donation,
     jit_hygiene,
     locks,
+    prng,
     recompile,
 )
 from torch_actor_critic_tpu.analysis.reachability import (
@@ -53,6 +56,9 @@ _FAMILY_CHECKS = (
     recompile.check,
     locks.check,
     conventions.check,
+    donation.check,
+    prng.check,
+    contracts.check,
 )
 
 

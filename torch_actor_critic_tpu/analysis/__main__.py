@@ -1,7 +1,14 @@
 """CLI for the tac-lint pass: ``python -m torch_actor_critic_tpu.analysis``.
 
-Exit codes: 0 clean, 1 findings, 2 usage/parse error. ``make lint``
-runs it over the package and ``scripts/``.
+Exit codes (text mode): 0 clean, 1 findings, 2 usage/parse error.
+``--json`` mode is the machine contract ``make lint``/CI diff against:
+one JSON object ``{"clean", "findings", "families", "exit_code"}`` on
+stdout, and a STABLE per-family exit code — 0 clean, 2 usage/parse
+error, ``FAMILY_EXIT_CODES[family]`` when exactly one family has
+findings, 1 when several do. The codes are part of the contract
+(docs/ANALYSIS.md): a CI gate can route "donation-safety regressed"
+(14) differently from "conventions slipped" (13) without parsing
+anything.
 """
 
 from __future__ import annotations
@@ -14,8 +21,33 @@ import sys
 from torch_actor_critic_tpu.analysis import (
     ALL_RULES,
     RULE_FAMILIES,
+    family_of,
     lint_paths,
 )
+
+# Stable per-family exit codes for --json mode. Append-only: new
+# families take the next free code; renumbering breaks CI routing.
+FAMILY_EXIT_CODES = {
+    "jit-hygiene": 10,
+    "recompile-risk": 11,
+    "lock-discipline": 12,
+    "conventions": 13,
+    "donation-safety": 14,
+    "prng-discipline": 15,
+    "contract-drift": 16,
+    "meta": 17,
+}
+
+
+def exit_code_for(families: "dict[str, int]") -> int:
+    """0 clean; the family's stable code when exactly one family has
+    findings; 1 for a mixed set."""
+    hit = [f for f, n in families.items() if n]
+    if not hit:
+        return 0
+    if len(hit) == 1:
+        return FAMILY_EXIT_CODES[hit[0]]
+    return 1
 
 
 def _default_paths() -> list:
@@ -48,7 +80,13 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--format", choices=("text", "json"), default="text",
-        help="finding output format",
+        help="finding output format (json: the raw findings list)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="json_mode",
+        help="machine-readable mode: one JSON object {clean, findings, "
+        "families, exit_code} and stable per-family exit codes "
+        "(docs/ANALYSIS.md) — what `make lint`/CI diff against",
     )
     parser.add_argument(
         "--select", default=None, metavar="RULES",
@@ -92,6 +130,18 @@ def main(argv=None) -> int:
         print(f"parse error: {e}", file=sys.stderr)
         return 2
 
+    if args.json_mode:
+        families = {name: 0 for name in RULE_FAMILIES}
+        for f in findings:
+            families[family_of(f.rule)] += 1
+        code = exit_code_for(families)
+        print(json.dumps({
+            "clean": not findings,
+            "findings": [f.as_dict() for f in findings],
+            "families": families,
+            "exit_code": code,
+        }, indent=2, sort_keys=True))
+        return code
     if args.format == "json":
         print(json.dumps([f.as_dict() for f in findings], indent=2))
     else:

@@ -1,0 +1,341 @@
+"""contract-drift rules: the jit entry-point wiring, checked as one table.
+
+Every jit entry point in this codebase owes three things beyond the
+program itself: a **recompilation-watchdog scope** (so a steady-state
+compile is attributed and flagged, PR 4), a **CostRegistry
+registration** (so roofline/MFU accounting sees it, PR 7), and — for
+sharded programs — **shardings derived from the shared
+``param_specs``/``fsdp_spec`` planners** (so the jit's at-rest layout
+cannot drift from what ``init_state`` placed, PR 8/14). Until now each
+PR wired these by hand and only a human reviewer noticed a missing
+piece; :data:`ENTRY_POINT_CONTRACTS` makes the wiring a checked table:
+
+* ``stale-contract`` — the table and ``reachability.ENTRY_POINTS``
+  must cover exactly the same identities, and each row's cost-name
+  literal must still be bound where the row says (a ``*_cost_name`` /
+  ``TRACE_PREFIX`` attribute, or a direct literal). A renamed
+  identity fails the run instead of silently losing its telemetry.
+* ``missing-watchdog-scope`` — the dispatch site the row names no
+  longer wraps the call in ``watchdog.source(...)`` with that
+  identity.
+* ``missing-cost-registration`` — the registering function the row
+  names no longer calls ``register_jit``/``register`` with that
+  identity.
+* ``incoherent-sharding`` — a sharded entry's builder no longer
+  derives its shardings from the shared planners
+  (``param_specs``/``fsdp_spec``/``named_param_shardings`` — directly
+  or through one same-class helper hop).
+
+All checks run on whole-package runs only (a fixture or single-file
+run cannot tell missing wiring from un-linted wiring).
+"""
+
+from __future__ import annotations
+
+import ast
+import typing as t
+
+from torch_actor_critic_tpu.analysis.reachability import (
+    ENTRY_POINTS,
+    Project,
+)
+from torch_actor_critic_tpu.analysis.walker import (
+    FileContext,
+    Finding,
+)
+
+__all__ = ["check", "ENTRY_POINT_CONTRACTS"]
+
+FAMILY = "contract-drift"
+
+# Names that count as deriving shardings from the shared planners.
+_SHARDING_PLANNERS = frozenset({
+    "param_specs", "fsdp_spec", "named_param_shardings",
+})
+
+
+class ContractRow(t.NamedTuple):
+    name_file: str                      # file binding the identity
+    name_attr: str | None               # attr assigned the literal
+    #                                     (None: literal used directly)
+    scope_file: str                     # file with the .source(...) call
+    scope_ref: str | None               # attr the source arg reads
+    #                                     (None: the literal itself)
+    register_fn: t.Tuple[str, str]      # (file, qualname) registering
+    register_ref: str | None            # attr the registration reads
+    #                                     (None: the literal itself)
+    sharded_builder: t.Tuple[str, str] | None  # (file, qualname) whose
+    #                                     shardings must come from the
+    #                                     shared planners
+
+
+# The checked wiring table, one row per reachability.ENTRY_POINTS
+# identity (key sets must match — stale-contract otherwise).
+ENTRY_POINT_CONTRACTS: t.Dict[str, ContractRow] = {
+    "train/update_burst": ContractRow(
+        name_file="parallel/dp.py", name_attr="burst_cost_name",
+        scope_file="sac/trainer.py", scope_ref=None,
+        register_fn=("sac/trainer.py", "Trainer._note_epoch_cost"),
+        register_ref="burst_cost_name",
+        sharded_builder=("parallel/dp.py", "DataParallelSAC._build_burst"),
+    ),
+    "train/population_burst": ContractRow(
+        name_file="parallel/population.py", name_attr="burst_cost_name",
+        scope_file="parallel/population.py", scope_ref=None,
+        register_fn=("sac/trainer.py", "Trainer._note_epoch_cost"),
+        register_ref="burst_cost_name",
+        sharded_builder=None,
+    ),
+    "train/ondevice_epoch": ContractRow(
+        name_file="sac/ondevice.py", name_attr="epoch_cost_name",
+        scope_file="sac/ondevice.py", scope_ref="epoch_cost_name",
+        register_fn=("sac/ondevice.py", "_note_epoch_cost"),
+        register_ref="epoch_cost_name",
+        sharded_builder=None,
+    ),
+    "train/population_epoch": ContractRow(
+        name_file="sac/ondevice.py", name_attr="epoch_cost_name",
+        scope_file="sac/ondevice.py", scope_ref="epoch_cost_name",
+        register_fn=("sac/ondevice.py", "_note_epoch_cost"),
+        register_ref="epoch_cost_name",
+        sharded_builder=None,
+    ),
+    "train/scenario_epoch": ContractRow(
+        name_file="scenarios/loop.py", name_attr="epoch_cost_name",
+        scope_file="sac/ondevice.py", scope_ref="epoch_cost_name",
+        register_fn=("sac/ondevice.py", "_note_epoch_cost"),
+        register_ref="epoch_cost_name",
+        sharded_builder=None,
+    ),
+    "serve/forward": ContractRow(
+        name_file="serve/engine.py", name_attr="TRACE_PREFIX",
+        scope_file="serve/engine.py", scope_ref="_trace_names",
+        register_fn=("serve/engine.py", "PolicyEngine.warmup"),
+        register_ref="_trace_names",
+        sharded_builder=None,
+    ),
+    "serve/sharded_forward": ContractRow(
+        name_file="serve/sharded.py", name_attr="TRACE_PREFIX",
+        scope_file="serve/engine.py", scope_ref="_trace_names",
+        register_fn=("serve/engine.py", "PolicyEngine.warmup"),
+        register_ref="_trace_names",
+        sharded_builder=(
+            "serve/sharded.py", "ShardedPolicyEngine._build_forwards",
+        ),
+    ),
+}
+
+
+def _find(project: Project, suffix: str) -> FileContext | None:
+    path = next((p for p in project.by_path if p.endswith(suffix)), None)
+    return project.by_path.get(path) if path else None
+
+
+def _binds_literal(ctx: FileContext, attr: str, literal: str) -> bool:
+    """Is ``<attr> = "<literal>"`` assigned anywhere in the file (a
+    class-level identity attribute)?"""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (
+            isinstance(node.value, ast.Constant)
+            and node.value.value == literal
+        ):
+            continue
+        for target in node.targets:
+            name = target.attr if isinstance(target, ast.Attribute) else (
+                target.id if isinstance(target, ast.Name) else None
+            )
+            if name == attr:
+                return True
+    return False
+
+
+def _mentions_ref(node: ast.AST, ref: str | None, literal: str) -> bool:
+    """Does the expression read the identity — the attr ``ref``
+    (``self.epoch_cost_name``, ``self._trace_names[b]``) or the
+    literal itself?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and sub.value == literal:
+            return True
+        if ref is not None and (
+            (isinstance(sub, ast.Attribute) and sub.attr == ref)
+            or (isinstance(sub, ast.Name) and sub.id == ref)
+        ):
+            return True
+    return False
+
+
+def _has_source_scope(
+    ctx: FileContext, ref: str | None, literal: str
+) -> bool:
+    """A ``<x>.source(ARG)`` call whose ARG reads the identity."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # `get_watchdog().source(...)` has a Call receiver, which
+        # dotted_name cannot flatten — match on the attribute name.
+        if not (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "source"
+        ):
+            continue
+        if node.args and _mentions_ref(node.args[0], ref, literal):
+            return True
+    return False
+
+
+def _has_registration(
+    ctx: FileContext, qualname: str, ref: str | None, literal: str
+) -> t.Tuple[bool, bool]:
+    """(fn_exists, registers): the named function exists and calls
+    ``register_jit``/``register`` with an identity-reading name arg."""
+    fn = next((f for f in ctx.functions if f.qualname == qualname), None)
+    if fn is None:
+        return False, False
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        # `get_cost_registry().register_jit(...)` has a Call receiver;
+        # match on the attribute name like _has_source_scope.
+        if not (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("register_jit", "register")
+        ):
+            continue
+        if node.args and _mentions_ref(node.args[0], ref, literal):
+            return True, True
+        # The name may be hoisted into a local (`name = self.dp
+        # .burst_cost_name; registry.register_jit(name, ...)`):
+        # accept when the registering function reads the ref anywhere.
+        if _mentions_ref(fn.node, ref, literal):
+            return True, True
+    return True, False
+
+
+def _builder_uses_planners(ctx: FileContext, qualname: str) -> bool:
+    """Does the builder reference a shared sharding planner — directly
+    or through one same-class helper method hop?"""
+    fn = next((f for f in ctx.functions if f.qualname == qualname), None)
+    if fn is None:
+        return False
+
+    def refs(node: ast.AST) -> t.Set[str]:
+        out: t.Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute):
+                out.add(sub.attr)
+            elif isinstance(sub, ast.Name):
+                out.add(sub.id)
+        return out
+
+    direct = refs(fn.node)
+    if direct & _SHARDING_PLANNERS:
+        return True
+    # The builder may consume pre-planned layouts through instance
+    # state (`self._replicated`, the at-rest placement from
+    # place_params): accept a planner reference anywhere in the
+    # builder's class — the drift being checked is the CLASS deriving
+    # layouts ad-hoc instead of from the shared planners.
+    cls = qualname.rsplit(".", 1)[0] if "." in qualname else None
+    if cls is None:
+        return False
+    for other in ctx.functions:
+        if other.qualname.startswith(f"{cls}."):
+            if refs(other.node) & _SHARDING_PLANNERS:
+                return True
+    return False
+
+
+def check(project: Project) -> t.List[Finding]:
+    findings: t.List[Finding] = []
+    if not any(
+        p.endswith("torch_actor_critic_tpu/__init__.py")
+        for p in project.by_path
+    ):
+        return findings
+    table_keys = set(ENTRY_POINT_CONTRACTS)
+    entry_keys = set(ENTRY_POINTS)
+    for missing in sorted(entry_keys - table_keys):
+        findings.append(Finding(
+            "stale-contract", "analysis/contracts.py", 1, 0,
+            f"entry point {missing!r} has no ENTRY_POINT_CONTRACTS row "
+            "(watchdog/cost/sharding wiring unchecked)",
+            "add the row to analysis/contracts.py — the table replaces "
+            "per-PR ad-hoc wiring",
+        ))
+    for extra in sorted(table_keys - entry_keys):
+        findings.append(Finding(
+            "stale-contract", "analysis/contracts.py", 1, 0,
+            f"ENTRY_POINT_CONTRACTS row {extra!r} matches no "
+            "reachability.ENTRY_POINTS identity; the entry it wired "
+            "is gone",
+            "remove the row, or restore the ENTRY_POINTS identity it "
+            "describes",
+        ))
+    for cost_name in sorted(table_keys & entry_keys):
+        row = ENTRY_POINT_CONTRACTS[cost_name]
+        # --- identity binding -----------------------------------------
+        name_ctx = _find(project, row.name_file)
+        if name_ctx is None or (
+            row.name_attr is not None
+            and not _binds_literal(name_ctx, row.name_attr, cost_name)
+        ):
+            findings.append(Finding(
+                "stale-contract", row.name_file, 1, 0,
+                f"entry point {cost_name!r}: identity is not bound as "
+                f"{row.name_attr!r} in {row.name_file!r} any more",
+                "update the attribute (or the ENTRY_POINT_CONTRACTS "
+                "row) so the identity has exactly one source of truth",
+            ))
+            continue
+        # --- watchdog scope -------------------------------------------
+        scope_ctx = _find(project, row.scope_file)
+        if scope_ctx is None or not _has_source_scope(
+            scope_ctx, row.scope_ref, cost_name
+        ):
+            findings.append(Finding(
+                "missing-watchdog-scope", row.scope_file, 1, 0,
+                f"entry point {cost_name!r}: no watchdog.source(...) "
+                f"scope reading the identity in {row.scope_file!r} — "
+                "steady-state recompiles of this program would be "
+                "unattributed",
+                "wrap the dispatch in `with get_watchdog()"
+                ".source(<identity>)` (see docs/OBSERVABILITY.md)",
+            ))
+        # --- cost registration ----------------------------------------
+        reg_ctx = _find(project, row.register_fn[0])
+        fn_exists, registers = (False, False) if reg_ctx is None else (
+            _has_registration(
+                reg_ctx, row.register_fn[1], row.register_ref, cost_name
+            )
+        )
+        if not fn_exists or not registers:
+            findings.append(Finding(
+                "missing-cost-registration", row.register_fn[0], 1, 0,
+                f"entry point {cost_name!r}: "
+                f"{row.register_fn[1]!r} no longer registers the "
+                "program's XLA cost analysis under the identity — "
+                "roofline/MFU accounting goes blind for it",
+                "call get_cost_registry().register_jit(<identity>, "
+                "...) from the dispatch/warmup path "
+                "(docs/OBSERVABILITY.md 'Cost attribution')",
+            ))
+        # --- sharding coherence ---------------------------------------
+        if row.sharded_builder is not None:
+            b_ctx = _find(project, row.sharded_builder[0])
+            if b_ctx is None or not _builder_uses_planners(
+                b_ctx, row.sharded_builder[1]
+            ):
+                findings.append(Finding(
+                    "incoherent-sharding", row.sharded_builder[0], 1, 0,
+                    f"entry point {cost_name!r}: builder "
+                    f"{row.sharded_builder[1]!r} no longer derives its "
+                    "shardings from the shared param_specs/fsdp_spec "
+                    "planners — the jit layout can drift from the "
+                    "at-rest placement and every burst pays a reshard",
+                    "derive in_shardings/out_shardings from parallel/"
+                    "sharding.py's param_specs/fsdp_spec (directly or "
+                    "via the class's sharding helper)",
+                ))
+    return findings

@@ -81,6 +81,11 @@ ENTRY_POINTS: t.Dict[str, t.Tuple[str, str]] = {
     "train/scenario_epoch": (
         "scenarios/loop.py", "ScenarioOnDeviceLoop._build_epoch",
     ),
+    # The population burst builds its jit inline in the dispatch
+    # method (no separate _build_*): the method IS the builder.
+    "train/population_burst": (
+        "parallel/population.py", "PopulationLearner.update_burst",
+    ),
     "serve/forward": ("serve/engine.py", "PolicyEngine._build_forwards"),
     "serve/sharded_forward": (
         "serve/sharded.py", "ShardedPolicyEngine._build_forwards",

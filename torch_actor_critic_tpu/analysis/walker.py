@@ -56,6 +56,22 @@ RULE_FAMILIES: t.Dict[str, t.Tuple[str, ...]] = {
         "unguarded-shared-attr",
         "unknown-guard",
     ),
+    "donation-safety": (
+        "use-after-donation",
+        "undonated-push",
+        "stale-donation-table",
+    ),
+    "prng-discipline": (
+        "key-reuse",
+        "key-split-nondestructive",
+        "key-loop-reuse",
+    ),
+    "contract-drift": (
+        "missing-watchdog-scope",
+        "missing-cost-registration",
+        "incoherent-sharding",
+        "stale-contract",
+    ),
     "conventions": (
         "silent-exception-swallow",
         "mutable-default-arg",

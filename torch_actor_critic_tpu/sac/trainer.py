@@ -661,6 +661,20 @@ class Trainer:
         # Current training epoch, maintained by the train loop (the
         # decoupled staging gate reads it as the staleness reference).
         self._epoch = 0
+        # Runtime transfer sanitizer (--sanitize, docs/ANALYSIS.md):
+        # False by default — every guarded site is then one bool check
+        # and the dispatch path is exactly the historical one.
+        self._sanitize = self.config.sanitize == "on"
+
+    def _sanitized(self):
+        """Device-phase guard context: ``jax.transfer_guard("disallow")``
+        under ``--sanitize on`` (implicit host<->device transfers on
+        the burst/drain path become hard failures; the explicit
+        ``device_put``/``device_get`` placements the trainer already
+        uses are exempt), a no-op otherwise."""
+        if self._sanitize:
+            return jax.transfer_guard("disallow")
+        return contextlib.nullcontext()
 
     # ------------------------------------------------------------ helpers
 
@@ -1139,7 +1153,10 @@ class Trainer:
                             self._host_params = (
                                 self._fetch_params_single_transfer()
                             )
-                        if rec is None and self.watchdog is None:
+                        if (
+                            rec is None and self.watchdog is None
+                            and not self._sanitize
+                        ):
                             self.state, self.buffer, m = self.dp.update_burst(
                                 self.state, self.buffer, chunk,
                                 cfg.updates_per_window,
@@ -1163,6 +1180,13 @@ class Trainer:
                                     stack.enter_context(
                                         rec.annotate("train/update_burst")
                                     )
+                                if self._sanitize:
+                                    # Sanitize tier: the burst dispatch
+                                    # must see device arrays only — an
+                                    # implicit transfer here is the
+                                    # hot-path bug this tier exists to
+                                    # catch (docs/ANALYSIS.md).
+                                    stack.enter_context(self._sanitized())
                                 self.state, self.buffer, m = (
                                     self.dp.update_burst(
                                         self.state, self.buffer, chunk,
@@ -1185,6 +1209,11 @@ class Trainer:
                                 k: v for k, v in m.items()
                                 if k not in ("loss_q", "loss_pi")
                             })
+                    elif self._sanitize:
+                        with self._sanitized():
+                            self.buffer = self.dp.push_chunk(
+                                self.buffer, chunk
+                            )
                     else:
                         self.buffer = self.dp.push_chunk(self.buffer, chunk)
                     if rec is not None:
@@ -1223,10 +1252,11 @@ class Trainer:
             # backend cannot deliver one output without executing the
             # program (unlike block_until_ready's event signaling, which
             # is what the axon tunnel gets wrong).
-            if losses_q:
-                drain(losses_q[-1])
-            else:
-                drain(self.buffer.size)
+            with self._sanitized():
+                if losses_q:
+                    drain(losses_q[-1])
+                else:
+                    drain(self.buffer.size)
             # dt covers the epoch's training work only (loop + drain):
             # t_epoch restarts at the END of the loop body, after the
             # sentinel check and checkpoint save, which report their own

@@ -67,14 +67,18 @@ class PendulumMultiTaskJax:
         """Task-conditioned initial pose: balance starts near upright
         (stabilization is only learnable from there within an episode);
         the other tasks use the full-circle Pendulum-v1 draw."""
-        k_theta, k_vel = jax.random.split(key)
-        full = jax.random.uniform(k_theta, (), minval=-jnp.pi, maxval=jnp.pi)
+        # One subkey per candidate draw (tac-lint key-reuse): both
+        # candidates of each where-select are computed every trace, and
+        # drawing them from one key makes `near` a scaled copy of
+        # `full`'s sample rather than an independent draw.
+        k_full, k_near, k_fast, k_slow = jax.random.split(key, 4)
+        full = jax.random.uniform(k_full, (), minval=-jnp.pi, maxval=jnp.pi)
         near = jax.random.uniform(
-            k_theta, (), minval=-0.15 * jnp.pi, maxval=0.15 * jnp.pi
+            k_near, (), minval=-0.15 * jnp.pi, maxval=0.15 * jnp.pi
         )
         theta = jnp.where(task == 1, near, full)
-        slow = jax.random.uniform(k_vel, (), minval=-0.2, maxval=0.2)
-        fast = jax.random.uniform(k_vel, (), minval=-1.0, maxval=1.0)
+        slow = jax.random.uniform(k_slow, (), minval=-0.2, maxval=0.2)
+        fast = jax.random.uniform(k_fast, (), minval=-1.0, maxval=1.0)
         theta_dot = jnp.where(task == 1, slow, fast)
         return theta, theta_dot
 

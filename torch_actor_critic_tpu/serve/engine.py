@@ -26,6 +26,7 @@ action with an explicit PRNG key.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import typing as t
@@ -93,10 +94,21 @@ class PolicyEngine:
         obs_spec: t.Any,
         max_batch: int = 64,
         buckets: t.Sequence[int] | None = None,
+        sanitize: bool = False,
     ):
         self.actor_def = actor_def
         self.obs_spec = obs_spec
         self.max_batch = int(max_batch)
+        # Transfer sanitizer (--sanitize, docs/ANALYSIS.md "Runtime
+        # sanitizers"): with the tier on, the forward dispatch runs
+        # under jax.transfer_guard("disallow") — any IMPLICIT
+        # host<->device transfer on the hot path (numpy leaking into
+        # the jit, a stray scalar) becomes a hard failure instead of an
+        # invisible per-request transfer tax. Inputs are then placed
+        # EXPLICITLY (jax.device_put, exempt from the guard) by
+        # _device_obs/_device_key. Off (the default) leaves the code
+        # path untouched.
+        self.sanitize = bool(sanitize)
         self.buckets = tuple(sorted(set(
             int(b) for b in (buckets or default_buckets(self.max_batch))
         )))
@@ -198,11 +210,20 @@ class PolicyEngine:
 
     def _device_obs(self, padded):
         """Pre-place one padded observation pytree for the forward
-        (identity here: jit moves host arrays itself)."""
+        (identity by default: jit moves host arrays itself). Under
+        ``sanitize`` the placement is an EXPLICIT ``jax.device_put`` so
+        the guarded forward sees device arrays only — the one
+        host->device hop per request, visible and intentional."""
+        if self.sanitize:
+            return jax.device_put(padded)
         return padded
 
     def _device_key(self, key):
-        """Pre-place the sampled-action PRNG key (identity here)."""
+        """Pre-place the sampled-action PRNG key (identity by default;
+        explicit ``device_put`` under ``sanitize``, mirroring
+        :meth:`_device_obs`)."""
+        if self.sanitize and key is not None:
+            return jax.device_put(key)
         return key
 
     def replicate(self) -> "PolicyEngine":
@@ -214,7 +235,7 @@ class PolicyEngine:
         shared."""
         return PolicyEngine(
             self.actor_def, self.obs_spec, max_batch=self.max_batch,
-            buckets=self.buckets,
+            buckets=self.buckets, sanitize=self.sanitize,
         )
 
     # ----------------------------------------------------------- buckets
@@ -276,16 +297,27 @@ class PolicyEngine:
         n = int(jax.tree_util.tree_leaves(obs)[0].shape[0])
         bucket = self.bucket_for(n)
         padded = self._device_obs(self._pad(obs, n, bucket))
+        # Sanitize tier: the dispatch itself runs with implicit
+        # transfers disallowed — the explicit _device_obs/_device_key
+        # placements above are exempt, so a clean path passes and a
+        # stray host value (numpy params, a scalar) fails loudly.
+        guard = (
+            jax.transfer_guard("disallow")
+            if self.sanitize else contextlib.nullcontext()
+        )
         with self._watchdog.source(self._trace_names[bucket]), \
                 jax.profiler.TraceAnnotation(self._trace_names[bucket]):
             if deterministic:
-                out, finite = self._fwd[True](params, padded)
+                with guard:
+                    out, finite = self._fwd[True](params, padded)
             else:
                 if key is None:
                     raise ValueError("sampled serving needs a PRNG key")
-                out, finite = self._fwd[False](
-                    params, padded, self._device_key(key)
-                )
+                device_key = self._device_key(key)
+                with guard:
+                    out, finite = self._fwd[False](
+                        params, padded, device_key
+                    )
         with self._lock:
             key_ = (bucket, bool(deterministic))
             if key_ not in self._compiled:

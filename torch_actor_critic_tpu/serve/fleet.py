@@ -231,6 +231,7 @@ class _SubmeshReplicaRegistry(_ReplicaRegistry):
                 self.fsdp_min_bytes if self.fsdp_min_bytes is not None
                 else FSDP_MIN_BYTES
             ),
+            sanitize=base_engine.sanitize,
         )
 
     def _place(self, engine, params) -> t.Tuple[t.Any, int]:

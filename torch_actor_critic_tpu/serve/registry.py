@@ -78,7 +78,13 @@ class ModelRegistry:
         reload_retry_backoff_s: float = 0.5,
         sleep: t.Callable[[float], None] = time.sleep,
         restore_shardings: t.Callable[[t.Any], t.Any] | None = None,
+        sanitize: bool = False,
     ):
+        # Transfer sanitizer tier (--sanitize, docs/ANALYSIS.md):
+        # every engine this registry builds runs its forward dispatch
+        # under jax.transfer_guard("disallow") with explicit input
+        # placement. Off = the engines are built exactly as before.
+        self._sanitize = bool(sanitize)
         # Direct-to-sharded checkpoint restore (sub-mesh serving,
         # docs/SERVING.md "Sharded serving & precision tiers"): a
         # callable (abstract actor-params tree -> Sharding tree) handed
@@ -146,7 +152,8 @@ class ModelRegistry:
                 "counter to 0)"
             )
         engine = PolicyEngine(
-            actor_def, obs_spec, max_batch=max_batch, buckets=buckets
+            actor_def, obs_spec, max_batch=max_batch, buckets=buckets,
+            sanitize=self._sanitize,
         )
         checkpointer = None
         epoch = None

@@ -165,6 +165,7 @@ class ShardedPolicyEngine(PolicyEngine):
         max_batch: int = 64,
         buckets: t.Sequence[int] | None = None,
         fsdp_min_bytes: int = FSDP_MIN_BYTES,
+        sanitize: bool = False,
     ):
         if tuple(mesh.axis_names) != ("tp", "fsdp"):
             raise ValueError(
@@ -181,7 +182,8 @@ class ShardedPolicyEngine(PolicyEngine):
         self.fsdp_min_bytes = int(fsdp_min_bytes)
         self._replicated = NamedSharding(mesh, P())
         super().__init__(
-            actor_def, obs_spec, max_batch=max_batch, buckets=buckets
+            actor_def, obs_spec, max_batch=max_batch, buckets=buckets,
+            sanitize=sanitize,
         )
 
     @property
@@ -328,4 +330,5 @@ class ShardedPolicyEngine(PolicyEngine):
             self.actor_def, self.obs_spec, self.mesh,
             precision=self._precision, max_batch=self.max_batch,
             buckets=self.buckets, fsdp_min_bytes=self.fsdp_min_bytes,
+            sanitize=self.sanitize,
         )

@@ -280,6 +280,15 @@ def main(argv=None):
                 "(XLA eliminates them) — running effectively at "
                 "diagnostics=off"
             )
+        if config.sanitize != "off":
+            logger.warning(
+                "--sanitize guards the host Trainer's device phases "
+                "and the serving forward path; the fused on-device "
+                "loop is ONE jit dispatch per epoch with no per-window "
+                "host boundary to guard — running effectively at "
+                "sanitize=off (the epoch drain already fetches via "
+                "explicit jax.device_get)"
+            )
         if config.population > 1:
             # Population-fused path: one dispatch advances N complete
             # learning curves; PBT exploit/explore events stream to

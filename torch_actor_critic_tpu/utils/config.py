@@ -296,6 +296,17 @@ class SACConfig:
     # The tier is read at trace time, so it is part of the compiled
     # program's identity — flipping it can never alias a cache entry.
     diagnostics: str = "off"
+    # Runtime transfer sanitizer (docs/ANALYSIS.md "Runtime
+    # sanitizers"): "on" wraps the Trainer's device phases (the
+    # update-burst/push dispatch and the epoch drain) in
+    # jax.transfer_guard("disallow"), so an IMPLICIT host<->device
+    # transfer on the hot path — numpy leaking into the jit, a stray
+    # Python scalar — is a hard failure in smokes instead of an
+    # invisible per-step transfer tax (the 0.02-MFU class). "off"
+    # (default) is no-op parity: the dispatch sites are untouched and
+    # the metric stream is bitwise identical (pinned by
+    # tests/test_sanitize.py).
+    sanitize: str = "off"
 
     def __post_init__(self):
         if not (len(self.filters) == len(self.kernel_sizes) == len(self.strides)):
@@ -400,6 +411,10 @@ class SACConfig:
             raise ValueError(
                 f"diagnostics must be 'off', 'light' or 'full', got "
                 f"{self.diagnostics!r}"
+            )
+        if self.sanitize not in ("off", "on"):
+            raise ValueError(
+                f"sanitize must be 'off' or 'on', got {self.sanitize!r}"
             )
         if self.max_rollbacks < 0:
             raise ValueError(

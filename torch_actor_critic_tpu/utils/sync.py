@@ -37,9 +37,13 @@ def drain(x) -> float:
             # local shard drains the local device queue, which is all a
             # local wall-clock needs.
             shard = x.addressable_shards[0].data
-            return float(jnp.sum(shard, dtype=jnp.float32))
+            return float(jax.device_get(jnp.sum(shard, dtype=jnp.float32)))
         # Reduce in f32: summing in x's own dtype would overflow bf16
         # (max ~3.4e38 but 8-bit mantissa loses integer exactness past
         # 256) or wrap small ints, making the checksum claim false.
-        return float(jnp.sum(x, dtype=jnp.float32))
+        # The fetch is an EXPLICIT jax.device_get: the drain is the
+        # hot path's one intentional device->host transfer, so it must
+        # stay legal under the --sanitize transfer guard
+        # (docs/ANALYSIS.md "Runtime sanitizers").
+        return float(jax.device_get(jnp.sum(x, dtype=jnp.float32)))
     return float(x)
