@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke shard-serve-smoke decouple-smoke visual-smoke scenario-smoke sanitize-smoke
+.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke shard-serve-smoke decouple-smoke visual-smoke scenario-smoke sanitize-smoke replay-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -162,6 +162,15 @@ sanitize-smoke:
 # multi-task scenario (docs/SCENARIOS.md).
 scenario-smoke:
 	JAX_PLATFORMS=cpu python scripts/scenario_smoke.py
+
+# Tiered-replay smoke (CPU, real CLI): --replay-tiers host is bitwise
+# vs the tiers-off loss stream (and tiers-off emits zero replay/
+# columns); a tiny-disk-budget run drives spill -> fifo evict ->
+# refill -> prefetch with the conservation invariant holding every
+# epoch; then --offline trains CQL-regularized SAC from the spilled
+# chunks to a saved checkpoint (docs/REPLAY.md).
+replay-smoke:
+	JAX_PLATFORMS=cpu python scripts/replay_smoke.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -2041,6 +2041,123 @@ def bench_telemetry_overhead(budget_s=420.0):
     return out
 
 
+def bench_replay(budget_s=300.0):
+    """Tiered-replay throughput (docs/REPLAY.md): the host-side costs
+    the tier stack adds around the (unchanged) device ring — waterfall
+    ingest with spill, task-balanced refill sampling, disk-tier chunk
+    append/sample on real files, and the ``--offline`` update burst.
+    All keys are ``*_per_sec`` so ``make bench-diff`` treats drops as
+    regressions."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from torch_actor_critic_tpu.replay import (
+        DiskTier,
+        TieredReplay,
+        rows_count,
+    )
+
+    t_start = time.time()
+    out = {}
+    obs_dim, act_dim, chunk_rows = 16, 4, 256
+
+    def mk_rows(n, seed=0):
+        rng = np.random.default_rng(seed)
+        return {
+            "states": rng.standard_normal((n, obs_dim)).astype(np.float32),
+            "next_states": rng.standard_normal(
+                (n, obs_dim)
+            ).astype(np.float32),
+            "actions": rng.standard_normal((n, act_dim)).astype(np.float32),
+            "rewards": rng.standard_normal(n).astype(np.float32),
+            "done": np.zeros(n, np.float32),
+        }
+
+    # --- waterfall ingest (HBM shadow -> host, every chunk spills) ----
+    tiers = TieredReplay(hbm_capacity=1024, host_capacity=8192)
+    chunk = mk_rows(chunk_rows)
+    tiers.ingest_rows(chunk)  # allocate rings outside the timed region
+    n_chunks, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < 2.0:
+        tiers.ingest_rows(chunk)
+        n_chunks += 1
+    dt = time.perf_counter() - t0
+    out["spill_rows_per_sec"] = round(n_chunks * chunk_rows / dt, 1)
+    out["conservation_ok"] = bool(tiers.conservation_holds())
+
+    # --- refill sampling off the host tier ----------------------------
+    n_draws, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < 2.0:
+        tiers.sample_refill(chunk_rows)
+        n_draws += 1
+    dt = time.perf_counter() - t0
+    out["refill_rows_per_sec"] = round(n_draws * chunk_rows / dt, 1)
+
+    # --- disk tier: npz chunk append + uniform sample on real files ---
+    root = tempfile.mkdtemp(prefix="bench_replay_")
+    try:
+        disk = DiskTier(root)
+        rng = np.random.default_rng(0)
+        n_app, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < 2.0 and n_app < 512:
+            disk.append(chunk)
+            n_app += 1
+        dt = time.perf_counter() - t0
+        out["disk_append_rows_per_sec"] = round(n_app * chunk_rows / dt, 1)
+        n_draws, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < 2.0:
+            got = disk.sample(rng, chunk_rows)
+            n_draws += 1
+        dt = time.perf_counter() - t0
+        assert rows_count(got) == chunk_rows
+        out["disk_sample_rows_per_sec"] = round(
+            n_draws * chunk_rows / dt, 1
+        )
+        disk.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    # --- offline burst (the --offline jit program) --------------------
+    if time.time() - t_start < budget_s - 30:
+        try:
+            import jax
+
+            from torch_actor_critic_tpu.replay.offline import (
+                OfflineLearner,
+                _stack_batches,
+            )
+            from torch_actor_critic_tpu.utils.config import SACConfig
+
+            cfg = SACConfig(
+                hidden_sizes=(64, 64), batch_size=64, offline=True,
+                offline_dataset="unused", offline_steps=100,
+            )
+            spec = jax.ShapeDtypeStruct((obs_dim,), np.float32)
+            learner = OfflineLearner(cfg, spec, act_dim)
+            state = learner.init_state(jax.random.PRNGKey(0))
+            data = mk_rows(4096)
+            sampler = np.random.default_rng(0)
+            burst = 20
+            batches = _stack_batches(data, sampler, burst, cfg.batch_size)
+            state, _ = learner.burst(state, batches)  # compile
+            steps, t0 = 0, time.perf_counter()
+            while time.perf_counter() - t0 < 10.0:
+                batches = _stack_batches(
+                    data, sampler, burst, cfg.batch_size
+                )
+                state, metrics = learner.burst(state, batches)
+                steps += burst
+            jax.block_until_ready(metrics)
+            dt = time.perf_counter() - t0
+            out["offline_grad_steps_per_sec"] = round(steps / dt, 1)
+        except Exception as e:  # noqa: BLE001 — per-section best effort
+            out.setdefault("errors", []).append(repr(e)[:200])
+    log(f"replay: {out}")
+    return out
+
+
 def bench_sanitize_overhead(budget_s=420.0):
     """Transfer-sanitizer cost (docs/ANALYSIS.md "Runtime sanitizers"):
     steady-state Trainer throughput with --sanitize off vs on at the
@@ -2384,6 +2501,12 @@ _STAGES = {
     },
     "sanitize_overhead": lambda: {
         "sanitize_overhead": bench_sanitize_overhead()
+    },
+    # Tiered-replay host-side costs + the --offline burst
+    # (docs/REPLAY.md) — spill/refill/disk rows-per-sec and offline
+    # grad-steps-per-sec for make bench-diff.
+    "replay": lambda: {
+        "replay": bench_replay(budget_s=stage_budget(300.0))
     },
     "on_device": lambda: {"on_device": bench_on_device()},
     # scenarios/ families (multi-agent / procedural / multi-task)
@@ -2786,6 +2909,17 @@ def main():
     )
     if res and "error" in res:
         diagnostics.append({"sanitize_stage_error": res.pop("error")})
+    if res:
+        out.update(res)
+
+    # 5f. Tiered-replay throughput (docs/REPLAY.md): waterfall spill,
+    # refill sampling, disk chunk IO and the --offline burst — host-
+    # side costs like the other instrumentation stages, CPU-pinned.
+    res = run_stage_subprocess(
+        "replay", 600, diagnostics, platform="cpu"
+    )
+    if res and "error" in res:
+        diagnostics.append({"replay_stage_error": res.pop("error")})
     if res:
         out.update(res)
 

@@ -150,6 +150,19 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                      help="SIGTERM graceful-drain flush budget in "
                           "seconds (answer everything accepted, then "
                           "exit 0)")
+    fwl = p.add_argument_group("data flywheel (docs/REPLAY.md)")
+    fwl.add_argument("--log-transitions", metavar="DIR", default=None,
+                     help="Log served transitions (obs/action from "
+                          "/act, outcome from POST /outcome) into a "
+                          "replay disk tier at DIR — the same chunk "
+                          "format train.py --offline consumes")
+    fwl.add_argument("--log-sample-every", type=int, default=1,
+                     help="Keep every Nth answered /act (traffic "
+                          "downsampling; 1 = keep all)")
+    fwl.add_argument("--log-max-bytes", type=int, default=0,
+                     help="Disk-tier byte budget for the transition "
+                          "log; oldest chunk files rotate out past it "
+                          "(0 = unbounded)")
     srv.add_argument("--trace-export", metavar="PATH", default=None,
                      help="Write a Perfetto (chrome://tracing) trace "
                           "to PATH at exit: per-request serving spans "
@@ -160,7 +173,8 @@ def parse_arguments(argv=None) -> argparse.Namespace:
 
 
 def _resolve_model(args):
-    """(actor_def, obs_spec, ckpt_dir) from the CLI's model source."""
+    """(actor_def, obs_spec, act_dim, act_limit, ckpt_dir) from the
+    CLI's model source."""
     import jax
     import jax.numpy as jnp
 
@@ -219,13 +233,16 @@ def _resolve_model(args):
     _Spec.act_dim = act_dim
     _Spec.act_limit = act_limit
     actor_def, _ = build_models(config, _Spec)
-    return actor_def, obs_spec, ckpt_dir
+    return actor_def, obs_spec, act_dim, act_limit, ckpt_dir
 
 
-def _worker_argv(argv):
+def _worker_argv(argv, worker: int | None = None):
     """The child argv for one fleet worker: the parent's args minus the
     fleet flags, with an ephemeral port (each worker prints its real
-    address on stdout; the parent reads it back)."""
+    address on stdout; the parent reads it back). A transition-log dir
+    becomes per-worker (``DIR/worker-N``) — disk-tier chunk sequence
+    numbers are per-directory, so two workers must never share one."""
+    import os
     import sys
 
     src = list(sys.argv[1:] if argv is None else argv)
@@ -240,6 +257,15 @@ def _worker_argv(argv):
         if a.split("=", 1)[0] in ("--fleet", "--port", "--router-poll"):
             continue
         out.append(a)
+    if worker is not None:
+        for i, a in enumerate(out):
+            if a == "--log-transitions" and i + 1 < len(out):
+                out[i + 1] = os.path.join(out[i + 1], f"worker-{worker}")
+            elif a.startswith("--log-transitions="):
+                base = a.split("=", 1)[1]
+                out[i] = "--log-transitions=" + os.path.join(
+                    base, f"worker-{worker}"
+                )
     return out + ["--port", "0"]
 
 
@@ -267,7 +293,7 @@ def run_fleet(args, argv):
     for i in range(args.fleet):
         proc = subprocess.Popen(
             [sys.executable, os.path.join(here, "serve.py")]
-            + _worker_argv(argv),
+            + _worker_argv(argv, worker=i),
             stdout=subprocess.PIPE, stderr=None, text=True, cwd=here,
         )
         workers.append(proc)
@@ -372,7 +398,7 @@ def main(argv=None):
         install_drain_handler,
     )
 
-    actor_def, obs_spec, ckpt_dir = _resolve_model(args)
+    actor_def, obs_spec, act_dim, act_limit, ckpt_dir = _resolve_model(args)
     buckets = (
         [int(b) for b in args.buckets.split(",")] if args.buckets else None
     )
@@ -444,6 +470,22 @@ def main(argv=None):
             f"--devices {devices} does not divide into --submesh "
             f"{tp}x{fsdp} groups of {tp * fsdp}"
         )
+    transition_logger = None
+    if args.log_transitions:
+        from torch_actor_critic_tpu.replay import TransitionLogger
+
+        transition_logger = TransitionLogger(
+            args.log_transitions, obs_spec, act_dim,
+            act_limit=act_limit,
+            sample_every=args.log_sample_every,
+            max_bytes=args.log_max_bytes,
+        )
+        logger.info(
+            "transition flywheel: logging 1/%d served acts to %s "
+            "(budget %s)",
+            args.log_sample_every, args.log_transitions,
+            args.log_max_bytes or "unbounded",
+        )
     server = PolicyServer(
         registry, host=args.host, port=args.port,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
@@ -458,6 +500,7 @@ def main(argv=None):
         ),
         submesh=submesh,
         precision=args.serve_precision,
+        transition_logger=transition_logger,
     )
     # Rolling-restart contract: SIGTERM stops admissions, answers every
     # accepted request, then serve_forever returns and we exit 0.
@@ -468,6 +511,10 @@ def main(argv=None):
     try:
         server.serve_forever()
     finally:
+        if transition_logger is not None:
+            # Flush the partial chunk so a drained worker's last
+            # transitions reach the dataset.
+            transition_logger.close()
         if args.trace_export:
             from torch_actor_critic_tpu.diagnostics.watchdog import (
                 get_watchdog,

@@ -213,6 +213,75 @@ def test_staging_pause_blocks_puts_until_resume():
     assert st.staged_total == 2
 
 
+def test_staging_drop_oldest_conserves_under_pause_resume_race():
+    """Conservation under the worst interleaving: drop_oldest evictions
+    racing pause()/resume() flips and a concurrent drainer. Every
+    accepted transition must land in exactly one counted outcome —
+    drained, dropped_backpressure, or still queued — with no path
+    (eviction inside put, StagingUnavailable on a paused buffer,
+    pop_window mid-flip) losing or double-counting a row."""
+    st = StagingBuffer(capacity=4, policy="drop_oldest")
+    n_producers, puts_each = 4, 60
+    accepted = [0] * n_producers
+    stop_flipping = threading.Event()
+
+    def producer(slot):
+        for i in range(puts_each):
+            while True:
+                try:
+                    assert st.put(txn(i))  # drop_oldest always admits
+                    accepted[slot] += 1
+                    break
+                except StagingUnavailable:
+                    # Paused mid-run: retry the SAME transition (the
+                    # documented actor contract).
+                    pass
+
+    def flipper():
+        while not stop_flipping.is_set():
+            st.pause()
+            st.resume()
+
+    drained_windows = [0]
+    producers_done = threading.Event()
+
+    def drainer():
+        while not (producers_done.is_set() and st.depth() < 2):
+            if st.pop_window(2) is not None:
+                drained_windows[0] += 1
+
+    threads = [
+        threading.Thread(target=producer, args=(s,), daemon=True)
+        for s in range(n_producers)
+    ]
+    threads += [
+        threading.Thread(target=flipper, daemon=True),
+        threading.Thread(target=drainer, daemon=True),
+    ]
+    for thr in threads:
+        thr.start()
+    for thr in threads[:n_producers]:
+        thr.join(30.0)
+    producers_done.set()
+    stop_flipping.set()
+    for thr in threads[n_producers:]:
+        thr.join(30.0)
+    assert all(not thr.is_alive() for thr in threads)
+
+    assert accepted == [puts_each] * n_producers
+    assert st.staged_total == n_producers * puts_each
+    assert st.drained_total == 2 * drained_windows[0]
+    assert not st.paused  # resume() was the flipper's last word
+    # The invariant the whole module exists for:
+    assert st.conservation_holds()
+    snap = st.snapshot()
+    assert snap["staged_total"] == (
+        snap["drained_total"]
+        + snap["dropped_backpressure_total"]
+        + snap["depth"]
+    )
+
+
 def test_staging_checkpoint_arrays_roundtrip_is_bitwise():
     st = StagingBuffer(capacity=8, max_lag=3)
     st.put(txn(0), generation=2, epoch=1)

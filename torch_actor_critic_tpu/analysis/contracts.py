@@ -107,6 +107,20 @@ ENTRY_POINT_CONTRACTS: t.Dict[str, ContractRow] = {
         register_ref="epoch_cost_name",
         sharded_builder=None,
     ),
+    "replay/prefetch_push": ContractRow(
+        name_file="replay/prefetch.py", name_attr="push_cost_name",
+        scope_file="replay/prefetch.py", scope_ref="push_cost_name",
+        register_fn=("replay/prefetch.py", "RefillPrefetcher.maybe_register_cost"),
+        register_ref="push_cost_name",
+        sharded_builder=None,
+    ),
+    "train/offline_burst": ContractRow(
+        name_file="replay/offline.py", name_attr="burst_cost_name",
+        scope_file="replay/offline.py", scope_ref="burst_cost_name",
+        register_fn=("replay/offline.py", "OfflineLearner.maybe_register_cost"),
+        register_ref="burst_cost_name",
+        sharded_builder=None,
+    ),
     "serve/forward": ContractRow(
         name_file="serve/engine.py", name_attr="TRACE_PREFIX",
         scope_file="serve/engine.py", scope_ref="_trace_names",

@@ -110,6 +110,14 @@ DONATING_ENTRY_POINTS: t.Dict[str, DonationRow] = {
         "parallel/population.py", "PopulationLearner.push_chunk",
         "push_chunk", (0,),
     ),
+    "replay/prefetch_push": DonationRow(
+        "replay/prefetch.py", "RefillPrefetcher._build_push",
+        "push_into", (0,),
+    ),
+    "train/offline_burst": DonationRow(
+        "replay/offline.py", "OfflineLearner._build_burst",
+        "burst", (0,),
+    ),
     "serve/forward": DonationRow(
         "serve/engine.py", "PolicyEngine._build_forwards", None, (1,),
     ),

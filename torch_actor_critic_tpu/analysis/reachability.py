@@ -86,6 +86,12 @@ ENTRY_POINTS: t.Dict[str, t.Tuple[str, str]] = {
     "train/population_burst": (
         "parallel/population.py", "PopulationLearner.update_burst",
     ),
+    "replay/prefetch_push": (
+        "replay/prefetch.py", "RefillPrefetcher._build_push",
+    ),
+    "train/offline_burst": (
+        "replay/offline.py", "OfflineLearner._build_burst",
+    ),
     "serve/forward": ("serve/engine.py", "PolicyEngine._build_forwards"),
     "serve/sharded_forward": (
         "serve/sharded.py", "ShardedPolicyEngine._build_forwards",

@@ -80,6 +80,26 @@ def estimate_buffer_bytes(capacity: int, obs_spec: t.Any, act_dim: int) -> int:
     return capacity * row
 
 
+def nbytes(state: t.Any) -> int:
+    """MEASURED bytes of a live buffer state's array leaves — the
+    as-allocated companion to :func:`estimate_buffer_bytes`'s planning
+    estimate (which knows nothing about striping, sequence-axis
+    sharding or the vmapped device axis). Works on any buffer state
+    pytree — ``BufferState``, ``StripedBufferState``, the dp-sharded
+    per-device tree — and on abstract ``ShapeDtypeStruct`` leaves
+    (shape x itemsize, no device query). Surfaced per epoch as
+    ``replay/hbm_bytes`` when tiers are on (metrics.jsonl, next to the
+    telemetry HBM watermarks).
+    """
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        n = getattr(leaf, "nbytes", None)
+        if n is None:
+            n = int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+        total += int(n)
+    return total
+
+
 def warn_if_buffer_exceeds_hbm(
     capacity: int,
     obs_spec: t.Any,

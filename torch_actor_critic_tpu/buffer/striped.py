@@ -151,6 +151,47 @@ def push_striped(state: StripedBufferState, chunk: Batch) -> StripedBufferState:
     )
 
 
+# ----------------------------------------------- host-side tier routing
+
+
+def rows_task_ids(
+    rows: t.Mapping[str, t.Any], n_stripes: int
+) -> "np.ndarray":
+    """Host-side (numpy) twin of :func:`_chunk_task_ids` over flat-key
+    spill rows (the ``replay/`` tier row format): recover each row's
+    task id from the one-hot in the trailing ``n_stripes`` dims of the
+    flat observation. Used by the tiered store's stripe→tier routing
+    (``replay/tiers.py``) so rows that fall off a striped HBM ring keep
+    their task identity on the way down the waterfall — never called
+    from traced code."""
+    import numpy as np
+
+    states = np.asarray(rows["states"])
+    oh = states[..., -n_stripes:]
+    oh = oh.reshape(oh.shape[0], -1, n_stripes)[:, -1, :]
+    return np.argmax(oh, axis=-1).astype(np.int32)
+
+
+def route_rows_to_stripes(
+    rows: t.Mapping[str, t.Any], n_stripes: int
+) -> t.List[t.Optional[t.Dict[str, t.Any]]]:
+    """Partition flat-key rows by task stripe: returns one row dict per
+    stripe (``None`` where the stripe got nothing), preserving within-
+    stripe row order. Host-side numpy only — the jit push/sample path
+    (:func:`push_striped`/:func:`sample_striped`) is untouched."""
+    import numpy as np
+
+    task = rows_task_ids(rows, n_stripes)
+    out: t.List[t.Optional[t.Dict[str, t.Any]]] = []
+    for stripe in range(n_stripes):
+        mask = task == stripe
+        if not mask.any():
+            out.append(None)
+            continue
+        out.append({k: np.asarray(v)[mask] for k, v in rows.items()})
+    return out
+
+
 def sample_striped(
     state: StripedBufferState, key: jax.Array, batch_size: int
 ) -> Batch:

@@ -1,0 +1,122 @@
+"""Tiered experience store: HBM ring ↔ host RAM ↔ disk (docs/REPLAY.md).
+
+The device ring (:mod:`torch_actor_critic_tpu.buffer.replay`) stays
+tier 0, bitwise-untouched; this package adds the host-side hierarchy
+underneath it — a host-RAM ring shadowing the device ring's eviction
+stream (:class:`~.tiers.HostRing`), an append-only chunked disk tier
+(:class:`~.diskstore.DiskTier`), counted spill/refill flows with a
+per-tier conservation invariant (:class:`~.tiers.TieredReplay`), async
+double-buffered host→HBM refill (:class:`~.prefetch.RefillPrefetcher`),
+a serve-side transition logger in the same chunk format
+(:class:`~.flywheel.TransitionLogger`), and ``train.py --offline``
+(:mod:`~.offline`) which trains regularized SAC purely from a disk
+tier. All of it default-off: ``replay_tiers="off"`` traces, samples
+and logs bitwise-identically to a build without this package.
+"""
+
+from __future__ import annotations
+
+import os
+import typing as t
+
+from torch_actor_critic_tpu.replay.diskstore import (
+    DISK_EVICTION_POLICIES,
+    DiskTier,
+    batch_to_rows,
+    concat_rows,
+    obs_spec_from_json,
+    obs_spec_to_json,
+    rows_count,
+    rows_nbytes,
+    rows_to_batch,
+    slice_rows,
+)
+from torch_actor_critic_tpu.replay.flywheel import TransitionLogger
+from torch_actor_critic_tpu.replay.offline import (
+    OFFLINE_REGULARIZERS,
+    OfflineLearner,
+    train_offline,
+)
+from torch_actor_critic_tpu.replay.prefetch import RefillPrefetcher
+from torch_actor_critic_tpu.replay.tiers import (
+    REPLAY_PRIORITIES,
+    HostRing,
+    StripedHostRing,
+    TieredReplay,
+)
+
+__all__ = [
+    "DISK_EVICTION_POLICIES",
+    "DiskTier",
+    "HostRing",
+    "OFFLINE_REGULARIZERS",
+    "OfflineLearner",
+    "REPLAY_PRIORITIES",
+    "RefillPrefetcher",
+    "StripedHostRing",
+    "TieredReplay",
+    "TransitionLogger",
+    "batch_to_rows",
+    "build_tiered_replay",
+    "concat_rows",
+    "obs_spec_from_json",
+    "obs_spec_to_json",
+    "rows_count",
+    "rows_nbytes",
+    "rows_to_batch",
+    "slice_rows",
+    "train_offline",
+]
+
+
+def build_tiered_replay(
+    config,
+    obs_spec: t.Any,
+    act_dim: int,
+    hbm_capacity: int,
+    act_limit: float = 1.0,
+    run_dir: str | None = None,
+    seed: int = 0,
+    n_stripes: int = 0,
+) -> TieredReplay:
+    """Construct the tier stack the config asks for.
+
+    ``replay_tiers="host"`` builds HBM+host only (spill past the host
+    ring is counted ``dropped_nodisk_total``); ``"disk"`` adds the
+    chunked disk tier at ``replay_dir`` (default: ``<run_dir>/replay``)
+    and stamps its meta so ``--offline`` can later reconstruct models
+    from the directory alone. ``n_stripes > 0`` gives the host tier
+    per-task sub-rings (``buffer/striped.py`` routing) so refill stays
+    task-balanced. Callers gate on ``config.replay_tiers != "off"`` —
+    this factory assumes tiers are wanted.
+    """
+    disk = None
+    if config.replay_tiers == "disk":
+        directory = config.replay_dir
+        if not directory:
+            if not run_dir:
+                raise ValueError(
+                    "replay_tiers='disk' needs --replay-dir (no tracker "
+                    "run dir to default under)"
+                )
+            directory = os.path.join(run_dir, "replay")
+        disk = DiskTier(
+            directory,
+            max_bytes=config.replay_disk_bytes,
+            policy=config.replay_disk_policy,
+        )
+        disk.ensure_meta({
+            "obs": obs_spec_to_json(obs_spec),
+            "act_dim": int(act_dim),
+            "act_limit": float(act_limit),
+            "source": "trainer",
+        })
+    host_capacity = config.replay_host_capacity or config.buffer_size
+    return TieredReplay(
+        hbm_capacity=hbm_capacity,
+        host_capacity=host_capacity,
+        disk=disk,
+        priority=config.replay_priority,
+        seed=seed,
+        n_stripes=n_stripes,
+    )

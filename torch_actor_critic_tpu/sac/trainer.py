@@ -657,6 +657,41 @@ class Trainer:
                 per_dev_capacity, self.pool.obs_spec, self.pool.act_dim,
                 self.mesh, sp=self.dp.effective_sp,
             )
+        # Tiered replay (replay/, docs/REPLAY.md): host-RAM/disk tiers
+        # shadowing the device ring, with counted spill/refill flows.
+        # Default-off — None, and every hot path is exactly historical
+        # (config validation rejects tiers with population > 1).
+        self.tiered = None
+        self._prefetcher = None
+        if self.config.replay_tiers != "off":
+            from torch_actor_critic_tpu.replay import (
+                RefillPrefetcher,
+                build_tiered_replay,
+            )
+
+            self.tiered = build_tiered_replay(
+                self.config, self.pool.obs_spec, self.pool.act_dim,
+                # The device ring's REAL total (per-shard capacity
+                # rounds down, then multiplies back over dp) — the
+                # shadow ring must evict exactly when the device ring
+                # overwrites.
+                hbm_capacity=(
+                    max(self.config.buffer_size // self.mesh.shape["dp"], 1)
+                    * self.mesh.shape["dp"]
+                ),
+                act_limit=float(getattr(self.pool, "act_limit", 1.0)),
+                run_dir=(
+                    str(self.tracker.run_dir)
+                    if self.tracker is not None and self.tracker.enabled
+                    else None
+                ),
+                seed=seed,
+            )
+            if self.config.replay_refill > 0:
+                self._prefetcher = RefillPrefetcher(
+                    self.tiered, self.n_envs, self.config.replay_refill,
+                    async_prefetch=self.config.replay_prefetch,
+                )
         self.start_epoch = 0
         # Current training epoch, maintained by the train loop (the
         # decoupled staging gate reads it as the staleness reference).
@@ -747,6 +782,42 @@ class Trainer:
         chunk = self._build_chunk(staging)
         del staging[:]
         return chunk
+
+    def _maybe_refill(self) -> None:
+        """Window-boundary host→HBM refill (replay/, docs/REPLAY.md):
+        take a staged ``(n_envs, replay_refill)`` chunk off the
+        prefetcher (already sampled on the background thread when
+        ``replay_prefetch``), place it exactly like an env chunk and
+        push it through the dedicated ``replay/prefetch_push`` program.
+        Refilled rows re-enter the waterfall as fresh pushes (counted
+        ``refill_rows_total``), keeping the conservation invariant
+        closed."""
+        local = self._prefetcher.poll_local_chunk()
+        if local is None:
+            return
+        chunk = shard_chunk_from_local(
+            local, self.mesh, sp=self.dp.effective_sp,
+        )
+        abstract = None
+        if self.telemetry is not None and not self._prefetcher._cost_registered:
+            try:
+                abstract = jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    (self.buffer, chunk),
+                )
+            except Exception:  # noqa: BLE001 — cost accounting must
+                # never break training
+                abstract = None
+        with self._sanitized():
+            self.buffer = self._prefetcher.push_into(self.buffer, chunk)
+        if abstract is not None:
+            self._prefetcher.maybe_register_cost(
+                abstract[0], abstract[1],
+                devices=int(self.mesh.devices.size),
+            )
+        from torch_actor_critic_tpu.replay import batch_to_rows
+
+        self.tiered.note_refill(batch_to_rows(local, n_lead=2))
 
     def _epoch_boundary_hook(
         self, epoch: int, sentinel_ok: bool, saved: bool,
@@ -842,7 +913,7 @@ class Trainer:
         """The JSON metadata saved beside the arrays; subclasses extend
         (the decoupled trainer adds staging counters and the serving
         plane's PRNG state, decoupled/learner.py)."""
-        return {
+        extra = {
             "config": self.config.to_json(),
             "normalizer": self.normalizer.state_dict(),
             "step": int(step),
@@ -850,6 +921,13 @@ class Trainer:
                 jax.random.key_data(self._act_key)
             ).astype(np.uint32).tolist(),
         }
+        if self.tiered is not None:
+            # Tier counters only (JSON-small): disk chunks persist
+            # themselves on disk; host-RAM residents are declared lost
+            # on restore (counted, conservation-clean) rather than
+            # serialized into every checkpoint.
+            extra["replay_tiers"] = self.tiered.meta_state()
+        return extra
 
     def _checkpoint_arrays(self):
         """Extra array pytree for the checkpoint ``arrays`` item (the
@@ -920,6 +998,11 @@ class Trainer:
                 key = jax.device_put(key, self._host_device)
             self._act_key = key
         self._restore_extras(meta, arrays)
+        if self.tiered is not None and meta.get("replay_tiers"):
+            # Resume re-anchors the tier counters; the disk tier
+            # already re-opened its chunk files from the manifest at
+            # construction (replay/diskstore.py).
+            self.tiered.load_meta(meta["replay_tiers"])
         return meta
 
     def _checkpoint_abstract_arrays(self, meta_probe: dict):
@@ -1111,6 +1194,12 @@ class Trainer:
                 # window) skips this boundary's device work entirely —
                 # the leftover transitions ride into the next window.
                 if window_full and local_chunk is not None:
+                    if self.tiered is not None:
+                        # Spill path (replay/): mirror the chunk into
+                        # the host waterfall BEFORE device placement —
+                        # host-side numpy only, the device stream is
+                        # untouched.
+                        self.tiered.ingest_chunk(local_chunk)
                     if self.population > 1:
                         # Leading axis is the member axis; the learner
                         # shards it over dp itself (no mesh resharding).
@@ -1216,6 +1305,13 @@ class Trainer:
                             )
                     else:
                         self.buffer = self.dp.push_chunk(self.buffer, chunk)
+                    if self._prefetcher is not None:
+                        # Refill AFTER the burst: an archival run
+                        # (replay_refill=0 has no prefetcher at all)
+                        # and the burst's own sample stream stay
+                        # bitwise-historical; the refill rows land for
+                        # the NEXT window's sampling.
+                        self._maybe_refill()
                     if rec is not None:
                         rec.lap(_PH_BURST)
 
@@ -1291,6 +1387,24 @@ class Trainer:
                 "env_steps_per_sec": env_steps_this_epoch / dt,
                 "grad_steps_per_sec": grad_steps_this_epoch / dt,
             }
+            if self.tiered is not None:
+                # Tier observability (replay/): per-tier depths, spill/
+                # refill counters and the conservation verdict, plus the
+                # MEASURED device-ring bytes (satellite of the config-
+                # only HBM budget). Keys appear only with tiers on — the
+                # default metrics.jsonl schema is bitwise-historical.
+                from torch_actor_critic_tpu.buffer.replay import (
+                    nbytes as buffer_nbytes,
+                )
+
+                last_metrics.update(self.tiered.metrics())
+                if self._prefetcher is not None:
+                    last_metrics.update(self._prefetcher.metrics())
+                last_metrics["replay/hbm_bytes"] = float(
+                    buffer_nbytes(self.buffer)
+                )
+                if rec is not None:
+                    rec.event("replay", epoch=e, **self.tiered.snapshot())
             # The loss materialization above and the diagnostics fetch
             # below are device fetches: charge them (plus the drain) to
             # the `drain` phase.
@@ -1531,6 +1645,10 @@ class Trainer:
             # programs; a successor trainer in the same process must
             # re-earn it (its first burst compile is legitimate).
             self.watchdog.clear_steady("train/")
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+        if self.tiered is not None:
+            self.tiered.close()
         if self.telemetry is not None:
             self.telemetry.close()
         self.pool.close()

@@ -344,8 +344,15 @@ class PolicyServer:
         submesh: t.Tuple[int, int] | None = None,
         precision: str = "f32",
         fsdp_min_bytes: int | None = None,
+        transition_logger=None,
     ):
         self.registry = registry
+        # Data flywheel (replay/flywheel.py, docs/REPLAY.md): when set,
+        # every successfully SERVED /act (behind admission — sheds and
+        # breaker refusals never log) is sampled into a replay disk
+        # tier, completed by the caller's POST /outcome. None (default)
+        # costs one pointer check per answered request.
+        self.transition_logger = transition_logger
         # Per-request trace spans (telemetry.traceview.RequestSpanLog):
         # attached by --trace-export; None costs one pointer check per
         # request in the batcher.
@@ -482,6 +489,16 @@ class PolicyServer:
                     # FLOPs/bytes over measured forward time
                     # (docs/OBSERVABILITY.md "Cost attribution").
                     snap["costs"] = server.metrics.cost_snapshot()
+                    # Flywheel intake counters (sampled acts, matched
+                    # outcomes, disk-tier residency).
+                    if server.transition_logger is not None:
+                        try:
+                            snap["flywheel"] = (
+                                server.transition_logger.snapshot()
+                            )
+                        except Exception as e:  # noqa: BLE001 — the
+                            # base snapshot must survive a broken hook
+                            snap["flywheel_error"] = repr(e)[:200]
                     if server.extra_snapshot is not None:
                         try:
                             snap.update(server.extra_snapshot())
@@ -502,6 +519,8 @@ class PolicyServer:
                     return
                 if self.path == "/act":
                     self._act(body)
+                elif self.path == "/outcome":
+                    self._outcome(body)
                 elif self.path == "/reload":
                     self._send(200, {
                         "reload": server.registry.reload(body.get("model"))
@@ -598,12 +617,64 @@ class PolicyServer:
                         headers=rid_hdr,
                     )
                     return
+                if server.transition_logger is not None:
+                    # Flywheel intake: the answered half of a
+                    # transition, keyed by the correlation id the
+                    # caller will echo in POST /outcome. Never allowed
+                    # to fail a request that was already served.
+                    try:
+                        server.transition_logger.note_act(
+                            rid, obs, np.asarray(res.action)
+                        )
+                    except Exception:  # noqa: BLE001
+                        logger.exception(
+                            "transition log failed (request_id=%s)", rid
+                        )
                 self._send(200, {
                     "action": np.asarray(res.action).tolist(),
                     "generation": res.generation,
                     "epoch": res.epoch,
                     "model": slot,
                 }, headers=rid_hdr)
+
+            def _outcome(self, body: dict):
+                """Complete a flywheel transition: the caller reports
+                what the environment did with the served action."""
+                if server.transition_logger is None:
+                    self._send(404, {
+                        "error": "transition logging is not enabled "
+                                 "(start with --log-transitions DIR)",
+                    })
+                    return
+                rid = body.get("request_id")
+                if not rid:
+                    self._send(400, {"error": 'missing "request_id"'})
+                    return
+                if "reward" not in body or "next_obs" not in body:
+                    self._send(400, {
+                        "error": 'missing "reward"/"next_obs"',
+                    })
+                    return
+                try:
+                    engine, _, _ = server.registry.acquire(
+                        body.get("model", "default")
+                    )
+                    next_obs = _parse_obs(
+                        body["next_obs"], engine.obs_spec
+                    )
+                    matched = server.transition_logger.note_outcome(
+                        rid,
+                        float(body["reward"]),
+                        next_obs,
+                        bool(body.get("done", False)),
+                    )
+                except (KeyError, ValueError, TypeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                # matched=False (unknown/expired/unsampled id) is not
+                # an error — downsampling drops ids by design; the
+                # caller should fire-and-forget outcomes.
+                self._send(200, {"logged": bool(matched), "request_id": rid})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True

@@ -246,6 +246,25 @@ def main(argv=None):
             summary["compile_spans"],
         )
 
+    if config.offline:
+        # Offline training (replay/, docs/REPLAY.md): the dataset is a
+        # replay disk tier — trainer spill or serve-side flywheel — and
+        # there is no env, mesh sharding or replay ring in the loop.
+        from torch_actor_critic_tpu.replay.offline import train_offline
+
+        logger.info(
+            "offline training from %s (reg=%s x %g, %d steps, run %s)",
+            config.offline_dataset or "<unset>", config.offline_reg,
+            config.offline_reg_weight, config.offline_steps,
+            tracker.run_id,
+        )
+        metrics = train_offline(
+            config, tracker=tracker, checkpointer=checkpointer,
+            seed=args.seed, telemetry=telemetry_rec,
+        )
+        export_trace_if_requested()
+        logger.info("final metrics: %s", metrics)
+        return metrics
     if config.on_device:
         # Scenario workloads (scenarios/, docs/SCENARIOS.md) resolve
         # through the same on-device registry; announce their structure

@@ -273,6 +273,49 @@ class SACConfig:
     # degrades to its local param snapshot instead of stalling envs.
     actor_timeout_s: float = 5.0
 
+    # --- tiered replay + offline training (replay/, docs/REPLAY.md) ---
+    # Tier stack under the HBM ring: "off" (parity default — no host
+    # mirroring, no extra metric keys, jit cache and replay stream
+    # bitwise identical to pre-tier builds), "host" (HBM + host-RAM
+    # ring; evictions past the host ring are counted and dropped), or
+    # "disk" (host evictions spill to append-only chunk files under
+    # replay_dir). Host-loop single-member training only.
+    replay_tiers: str = "off"
+    # Host-ring capacity in transitions; 0 = auto (= buffer_size, i.e.
+    # the host tier holds as much again as the device ring).
+    replay_host_capacity: int = 0
+    # Disk-tier directory; "" = <run_dir>/replay under the tracker.
+    replay_dir: str = ""
+    # Disk-tier byte budget; 0 = unbounded. Over budget the eviction
+    # policy applies per chunk file: "fifo" deletes oldest chunks,
+    # "stop" refuses new appends (counted, never raises).
+    replay_disk_bytes: int = 0
+    replay_disk_policy: str = "fifo"
+    # Host-tier sampling prior for refill draws: "uniform" over the
+    # resident window or "recent" (newest half).
+    replay_priority: str = "uniform"
+    # Refill rows per env per update window pushed back HBM-ward from
+    # the host tier (0 = archival only: tiers record spill but never
+    # feed samples back, leaving the device stream bit-identical).
+    replay_refill: int = 0
+    # Stage refill chunks on a background thread (double-buffered) so
+    # the host→device copy hides behind the update burst; False
+    # samples synchronously at the window boundary (the measured
+    # stall, bench.py --stage=replay).
+    replay_prefetch: bool = True
+
+    # Offline training (train.py --offline): no env in the loop — the
+    # dataset is a replay disk tier (trainer spill or serve-side
+    # flywheel), loaded to host RAM and sampled by a host RNG.
+    offline: bool = False
+    offline_dataset: str = ""
+    # Off-support Q-overestimation counterweight: "none" (plain SAC
+    # steps), "bc" (behavior-cloning MSE anchor on the actor), "cql"
+    # (conservative logsumexp gap penalty on the critic).
+    offline_reg: str = "none"
+    offline_reg_weight: float = 1.0
+    offline_steps: int = 10000
+
     # --- observability (telemetry/, docs/OBSERVABILITY.md) ---
     # Per-step phase spans (act/env_step/stage/place_chunk/
     # burst_dispatch/drain/sentinel/checkpoint), per-epoch device HBM
@@ -457,6 +500,74 @@ class SACConfig:
                     f"smaller than one update window "
                     f"(update_every={self.update_every}); the learner "
                     "could never drain a fixed-size window"
+                )
+        if self.replay_tiers not in ("off", "host", "disk"):
+            raise ValueError(
+                f"replay_tiers must be 'off', 'host' or 'disk', got "
+                f"{self.replay_tiers!r}"
+            )
+        if self.replay_disk_policy not in ("fifo", "stop"):
+            raise ValueError(
+                f"replay_disk_policy must be 'fifo' or 'stop', got "
+                f"{self.replay_disk_policy!r}"
+            )
+        if self.replay_priority not in ("uniform", "recent"):
+            raise ValueError(
+                f"replay_priority must be 'uniform' or 'recent', got "
+                f"{self.replay_priority!r}"
+            )
+        if self.replay_host_capacity < 0:
+            raise ValueError(
+                f"replay_host_capacity must be >= 0 (0 = auto), got "
+                f"{self.replay_host_capacity}"
+            )
+        if self.replay_disk_bytes < 0:
+            raise ValueError(
+                f"replay_disk_bytes must be >= 0 (0 = unbounded), got "
+                f"{self.replay_disk_bytes}"
+            )
+        if self.replay_refill < 0:
+            raise ValueError(
+                f"replay_refill must be >= 0 (0 = archival only), got "
+                f"{self.replay_refill}"
+            )
+        if self.replay_refill > 0 and self.replay_tiers == "off":
+            raise ValueError(
+                "replay_refill > 0 needs a tier stack to refill from; "
+                "pass --replay-tiers host or disk"
+            )
+        if self.replay_tiers != "off":
+            if self.on_device:
+                raise ValueError(
+                    "replay_tiers is the host-loop storage hierarchy; "
+                    "on_device keeps the whole ring in the compiled "
+                    "program — the two cannot compose"
+                )
+            if self.population > 1:
+                raise ValueError(
+                    "replay_tiers does not compose with population > 1 "
+                    "(per-member tier stacks are not wired)"
+                )
+        if self.offline_reg not in ("none", "bc", "cql"):
+            raise ValueError(
+                f"offline_reg must be 'none', 'bc' or 'cql', got "
+                f"{self.offline_reg!r}"
+            )
+        if self.offline_reg_weight < 0:
+            raise ValueError(
+                f"offline_reg_weight must be >= 0, got "
+                f"{self.offline_reg_weight}"
+            )
+        if self.offline_steps < 1:
+            raise ValueError(
+                f"offline_steps must be >= 1, got {self.offline_steps}"
+            )
+        if self.offline:
+            if self.on_device or self.decoupled or self.population > 1:
+                raise ValueError(
+                    "--offline trains from a disk tier with no env in "
+                    "the loop; it does not compose with on_device, "
+                    "decoupled or population > 1"
                 )
         if self.actor_param_lag and not self.host_actor:
             raise ValueError(
