@@ -132,7 +132,12 @@ shard-serve-smoke:
 # SIGKILLed mid-collection (actors degrade to the local snapshot, envs
 # never stall), the learner SIGTERMs mid-epoch (requeue 75) and
 # resumes: zero accepted transitions lost, staleness bounded by
-# --max-actor-lag (docs/RESILIENCE.md "Decoupled-plane failure modes").
+# --max-actor-lag; (3) actor-process fleet — train.py --actors 3 over
+# the networked staging transport with TAC_FLAKY_PUSH drops, one actor
+# SIGKILLed (supervised restart + dead-actor purge), learner SIGTERM ->
+# requeue 75 -> resume with restored dedup watermarks: the extended
+# conservation invariant green, no push lost or double-ingested
+# (docs/RESILIENCE.md "Decoupled-plane failure modes").
 decouple-smoke:
 	JAX_PLATFORMS=cpu python scripts/decouple_smoke.py
 

@@ -2305,6 +2305,114 @@ def bench_decoupled(budget_s=420.0, max_actor_lag=4):
     return out
 
 
+def bench_actor_fleet(budget_s=240.0, sizes=(1, 2, 4), max_actor_lag=4):
+    """Actor-fleet scaling (docs/RESILIENCE.md "Decoupled-plane failure
+    modes"): learner throughput and staleness as ``--actors N`` fleet
+    actors feed the staging buffer over the real networked transport
+    (HTTP push, per-actor seq dedup). Actors run on threads through the
+    exact ``_actor_loop`` the subprocess shim runs — same wire path,
+    same heartbeats, without charging each sweep point a fresh jax
+    import — so the curve isolates the transport + contention cost.
+    bench-diff picks up the per-size ``*_per_sec`` keys."""
+    import threading
+
+    from torch_actor_critic_tpu.decoupled import FleetTrainer
+    from torch_actor_critic_tpu.decoupled.fleet import _actor_loop
+    from torch_actor_critic_tpu.parallel import make_mesh
+    from torch_actor_critic_tpu.utils.config import SACConfig
+
+    t_start = time.time()
+    tiny = dict(
+        hidden_sizes=(32, 32), batch_size=32, epochs=3,
+        steps_per_epoch=400, start_steps=50, update_after=50,
+        update_every=50, buffer_size=5000, max_ep_len=200,
+        save_every=1000, sentinel=False,
+    )
+    out: dict = {
+        "config": dict(tiny, max_actor_lag=max_actor_lag),
+        "sizes": list(sizes),
+    }
+
+    class _ThreadProc:
+        _pid = iter(range(2 ** 24, 2 ** 25))
+
+        def __init__(self, body):
+            self.pid = next(self._pid)
+            self.exitcode = None
+            self.stop = threading.Event()
+            self._t = threading.Thread(
+                target=body, args=(self.stop,), daemon=True
+            )
+            self._t.start()
+
+        def is_alive(self):
+            return self._t.is_alive()
+
+        def join(self, timeout=None):
+            self.stop.set()
+            self._t.join(timeout)
+
+    for n in sizes:
+        if time.time() - t_start > budget_s:
+            out.setdefault("skipped_sizes", []).append(n)
+            log(f"actor_fleet: budget exhausted, skipping actors={n}")
+            continue
+        try:
+            cfg = SACConfig(
+                **tiny, actors=n, staging_policy="shed",
+                max_actor_lag=max_actor_lag, heartbeat_timeout_s=30.0,
+            )
+            holder: dict = {}
+
+            def spawn(aid, inc, _h=holder):
+                return _ThreadProc(lambda stop: _actor_loop(
+                    aid, inc, _h["tr"].transport.address,
+                    "Pendulum-v1", 1, 3000 + 10 * aid + inc, stop,
+                    options={"heartbeat_interval_s": 0.5,
+                             "push_retry_s": 1.0},
+                ))
+
+            tr = FleetTrainer(
+                "Pendulum-v1", cfg, mesh=make_mesh(dp=1), seed=0,
+                spawn=spawn,
+            )
+            holder["tr"] = tr
+            epoch_rates, epoch_grad = [], []
+            real_hook = tr._epoch_boundary_hook
+
+            def hook(e, ok, saved, metrics, rec, _real=real_hook):
+                _real(e, ok, saved, metrics, rec)
+                epoch_rates.append(metrics["env_steps_per_sec"])
+                epoch_grad.append(metrics["grad_steps_per_sec"])
+
+            tr._epoch_boundary_hook = hook
+            try:
+                tr.train()
+                lag = tr.staging.snapshot()["actor_lag"]
+                tsnap = tr.transport.snapshot()
+                conserved = tr.staging.conservation_holds()
+            finally:
+                tr.close()
+            # Post-warmup epochs only (epoch 0 pays the jit compiles).
+            out[f"actors{n}_env_steps_per_sec"] = round(
+                max(epoch_rates[1:] or epoch_rates), 1
+            )
+            out[f"actors{n}_grad_steps_per_sec"] = round(
+                max(epoch_grad[1:] or epoch_grad), 1
+            )
+            out[f"actors{n}_lag"] = lag
+            out[f"actors{n}_transport_accepted"] = tsnap[
+                "accepted_total"
+            ]
+            out[f"actors{n}_conserved"] = bool(conserved)
+        except Exception as e:  # noqa: BLE001 — per-size best effort
+            out.setdefault("errors", []).append(
+                f"actors={n}: {e!r}"[:200]
+            )
+    log(f"actor_fleet: {out}")
+    return out
+
+
 def bench_diagnostics_overhead(budget_s=540.0):
     """Learning-health diagnostics cost (docs/OBSERVABILITY.md
     "Learning-health diagnostics"): steady-state Trainer throughput at
@@ -2491,7 +2599,14 @@ _STAGES = {
             budget_s=stage_budget(180.0)
         ),
     },
-    "decoupled": lambda: {"decoupled": bench_decoupled()},
+    "decoupled": lambda: {
+        "decoupled": bench_decoupled(),
+        # Actors-vs-throughput curves over the networked staging
+        # transport (--actors {1,2,4}).
+        "actor_fleet": bench_actor_fleet(
+            budget_s=stage_budget(240.0)
+        ),
+    },
     "host_envs": lambda: {"host_envs": bench_host_envs()},
     "telemetry_overhead": lambda: {
         "telemetry_overhead": bench_telemetry_overhead()
@@ -2855,10 +2970,12 @@ def main():
 
     # 5a''''. Decoupled actor/learner (docs/RESILIENCE.md): lockstep vs
     # acting-through-the-serving-plane throughput at equal config, plus
-    # the staleness distribution against --max-actor-lag. Host-side
-    # cost measurement like the serving stages; same backend.
+    # the staleness distribution against --max-actor-lag, plus the
+    # actor-fleet scaling curve (--actors {1,2,4} over the networked
+    # staging transport). Host-side cost measurement like the serving
+    # stages; same backend.
     res = run_stage_subprocess(
-        "decoupled", 540, diagnostics, platform=serving_platform
+        "decoupled", 900, diagnostics, platform=serving_platform
     )
     if res and "error" in res:
         diagnostics.append({"decoupled_stage_error": res.pop("error")})

@@ -25,11 +25,28 @@ Phase 2 — subprocess chaos, real signals, real HTTP:
    replay and exits with requeue code 75;
 5. the learner resumes (``--run <id>``) and completes.
 
+Phase 3 — actor-process fleet chaos (``train.py --actors 3``), real
+subprocess actors over the networked staging transport, pushes made
+flaky via TAC_FLAKY_PUSH:
+
+1. the learner comes up with 3 supervised actor subprocesses feeding
+   its staging buffer over HTTP (flaky push path: drops + latency);
+2. one actor is **SIGKILLed mid-collection**: the supervisor declares
+   it dead, purges its staged tail (counted ``dropped_dead_actor``),
+   and restarts the slot as a new incarnation (counted
+   ``actor_restarts``);
+3. the learner gets **SIGTERM mid-epoch**: drains, checkpoints the
+   staged tail + per-actor dedup watermarks, exits 75;
+4. the learner resumes (``--run <id>``) on the SAME transport port,
+   respawns the fleet above the restored watermarks, completes rc 0.
+
 Asserted at the end: requeue/rc discipline, zero accepted transitions
-lost (the staging conservation invariant over the WHOLE run, across
-the restart), every recorded generation lag <= --max-actor-lag, at
-least one degradation AND one re-home observed, and finite final
-metrics.
+lost (the staging conservation invariant — including the dead-actor
+term — over the WHOLE run, across the restart), at least one
+supervised restart, every accepted push accounted per actor (the
+sequence-number audit), every recorded generation lag <=
+--max-actor-lag, at least one degradation AND one re-home observed,
+and finite final metrics.
 """
 
 import json
@@ -339,6 +356,156 @@ def _finite(v):
         return False
 
 
+# ------------------------------------------------ phase 3: actor fleet
+
+
+def phase_fleet(root: Path):
+    """SIGKILL an actor subprocess, SIGTERM the learner, resume — all
+    over the networked staging transport with a flaky push path."""
+    import urllib.request as urlreq
+
+    runs_root = root / "runs"
+    fleet_port = free_port()
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        # Transport flap on every actor's push path: 20% drops plus
+        # 5ms latency, under the client's retry/backoff loop.
+        TAC_FLAKY_PUSH="drop_rate=0.2,latency_s=0.005,seed=1",
+    )
+    flags = TRAIN_FLAGS + [
+        "--actors", "3",
+        "--actor-max-restarts", "3",
+        # Loose deadline: 3 actor processes + the learner share one CI
+        # CPU, and a jax-compile stall is scheduling pressure, not
+        # death — the injected SIGKILL is what must drive the restart.
+        "--heartbeat-timeout-s", "10",
+        # Pinned so the resumed learner rebinds the same address and
+        # /metrics stays reachable across the restart.
+        "--fleet-port", str(fleet_port),
+        "--epochs", "3",
+    ]
+
+    def launch(extra):
+        return subprocess.Popen(
+            [sys.executable, "-m", "torch_actor_critic_tpu.train",
+             *flags, *extra,
+             "--runs-root", str(runs_root), "--experiment", "fleet"],
+            cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+
+    def transport_metrics():
+        try:
+            with urlreq.urlopen(
+                f"http://127.0.0.1:{fleet_port}/metrics", timeout=2
+            ) as r:
+                return json.loads(r.read().decode())
+        except Exception:
+            return None
+
+    log(f"phase 3: learner + 3 supervised actor subprocesses "
+        f"(transport :{fleet_port}, flaky pushes) ...")
+    learner = launch([])
+    try:
+        run_dir = wait_for(
+            lambda: next(iter((runs_root / "fleet").glob("*")), None),
+            "the fleet run dir",
+        )
+        run_id = run_dir.name
+        metrics = run_dir / "metrics.jsonl"
+
+        # Wait until the fleet actually feeds the learner over HTTP.
+        snap = wait_for(
+            lambda: (
+                (m := transport_metrics()) is not None
+                and m["transport"]["accepted_total"] > 0
+                and len(m["transport"]["actors"]) >= 3
+                and m
+            ),
+            "fleet pushes over the transport",
+        )
+        victim_pid = next(
+            a["pid"] for a in snap["transport"]["actors"].values()
+            if a.get("pid") not in (None, learner.pid)
+        )
+        log(f"phase 3: SIGKILL actor pid {victim_pid} mid-collection ...")
+        os.kill(victim_pid, signal.SIGKILL)
+
+        restarts = wait_for(
+            lambda: (
+                (m := transport_metrics()) is not None
+                and len(m["transport"]["actors"]) >= 3
+                and metrics_lines(metrics)
+                and metrics_lines(metrics)[-1].get(
+                    "decoupled/actor_restarts", 0
+                ) >= 1
+                and metrics_lines(metrics)[-1]
+            ),
+            "the supervised restart to reach the metrics log",
+        )
+        log("phase 3: restart observed (actor_restarts="
+            f"{restarts['decoupled/actor_restarts']}); SIGTERM the "
+            "learner mid-epoch ...")
+        learner.send_signal(signal.SIGTERM)
+        rc = learner.wait(timeout=600)
+        if rc != 75:
+            fail(f"fleet learner exited rc={rc}, expected requeue 75")
+
+        log("phase 3: resume with reconnecting fleet ...")
+        learner = launch(["--run", run_id])
+        rc = learner.wait(timeout=600)
+        if rc != 0:
+            fail(f"fleet resume exited rc={rc}")
+
+        final = metrics_lines(metrics)[-1]
+        for key in ("loss_q", "loss_pi", "reward"):
+            if not _finite(final.get(key)):
+                fail(f"final {key} not finite: {final.get(key)}")
+        # The EXTENDED conservation invariant, across the actor kill
+        # AND the learner restart: every staged transition drained,
+        # dropped by an accounted policy, purged with its dead actor,
+        # or still in the buffer.
+        staged = final["decoupled/staged_total"]
+        accounted = (
+            final["decoupled/drained_total"]
+            + final["decoupled/dropped_stale_total"]
+            + final["decoupled/dropped_backpressure_total"]
+            + final["decoupled/dropped_dead_actor_total"]
+            + final["decoupled/staging_depth"]
+        )
+        if staged != accounted:
+            fail(f"fleet conservation violated: staged={staged} vs "
+                 f"accounted={accounted}")
+        if final.get("decoupled/conservation_ok") != 1:
+            fail("the learner's own epoch-boundary conservation check "
+                 "went red")
+        if final["decoupled/actor_restarts"] < 1:
+            fail("expected >= 1 supervised actor restart")
+        if final["decoupled/transport_accepted_total"] <= 0:
+            fail("the fleet never fed the learner over the transport")
+        if final["decoupled/transport_rejected_malformed_total"] != 0:
+            fail("well-formed fleet pushes were rejected as malformed")
+        if final["decoupled/actor_lag_max"] > MAX_ACTOR_LAG:
+            fail(f"recorded lag {final['decoupled/actor_lag_max']} "
+                 f"exceeds --max-actor-lag {MAX_ACTOR_LAG}")
+        log(
+            "phase 3 OK: staged=%d drained=%d dead_actor=%d depth=%d "
+            "accepted=%d duplicates=%d restarts=%d" % (
+                staged, final["decoupled/drained_total"],
+                final["decoupled/dropped_dead_actor_total"],
+                final["decoupled/staging_depth"],
+                final["decoupled/transport_accepted_total"],
+                final["decoupled/transport_duplicate_pushes_total"],
+                final["decoupled/actor_restarts"],
+            )
+        )
+    finally:
+        if learner.poll() is None:
+            learner.kill()
+            learner.wait(timeout=30)
+
+
 def main():
     import tempfile
 
@@ -346,9 +513,11 @@ def main():
         root = Path(td)
         phase_bitwise(root / "bitwise")
         phase_chaos(root / "chaos")
+        phase_fleet(root / "fleet")
     log("ALL OK: both role kills survived; zero accepted transitions "
         "lost; replay bitwise across the learner resume; staleness "
-        "bounded by the lag knob")
+        "bounded by the lag knob; the actor fleet survived a SIGKILL + "
+        "learner restart with the extended invariant green")
 
 
 if __name__ == "__main__":

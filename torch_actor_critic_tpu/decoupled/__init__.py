@@ -3,20 +3,39 @@
 plane, transitions flow through a bounded staging buffer with a
 staleness admission gate, and the learner publishes epochs via the
 validated hot-reload — every link fault-injected and recovery-proven
-(``make decouple-smoke``)."""
+(``make decouple-smoke``). ``--actors N`` scales the actor side to a
+supervised process fleet over a networked staging transport
+(``fleet.py`` / ``transport.py``): heartbeat liveness, SIGKILL-reap +
+jittered-backoff restarts, and idempotent per-actor sequence-numbered
+ingestion, with the conservation invariant extended across process
+boundaries."""
 
 from torch_actor_critic_tpu.decoupled.actor import ActorWorker
+from torch_actor_critic_tpu.decoupled.fleet import (
+    FleetSupervisor,
+    FleetTrainer,
+    actor_main,
+)
 from torch_actor_critic_tpu.decoupled.learner import DecoupledTrainer
 from torch_actor_critic_tpu.decoupled.staging import (
     StagedTransition,
     StagingBuffer,
     StagingUnavailable,
 )
+from torch_actor_critic_tpu.decoupled.transport import (
+    RemoteStagingClient,
+    StagingTransportServer,
+)
 
 __all__ = [
     "ActorWorker",
     "DecoupledTrainer",
+    "FleetSupervisor",
+    "FleetTrainer",
+    "RemoteStagingClient",
     "StagedTransition",
     "StagingBuffer",
+    "StagingTransportServer",
     "StagingUnavailable",
+    "actor_main",
 ]

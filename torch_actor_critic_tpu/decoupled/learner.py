@@ -241,9 +241,16 @@ class DecoupledTrainer(Trainer):
             "decoupled/dropped_stale_total": snap["dropped_stale_total"],
             "decoupled/dropped_backpressure_total":
                 snap["dropped_backpressure_total"],
+            "decoupled/dropped_dead_actor_total":
+                snap["dropped_dead_actor_total"],
             "decoupled/shed_total": snap["shed_total"],
             "decoupled/blocked_total": snap["blocked_total"],
             "decoupled/staging_depth": snap["depth"],
+            # The cross-process conservation invariant, checked every
+            # epoch: staged == drained + dropped_stale +
+            # dropped_backpressure + dropped_dead_actor + depth.
+            "decoupled/conservation_ok":
+                float(self.staging.conservation_holds()),
             "decoupled/actor_lag_mean": lag.get("actor_lag_mean", 0.0),
             "decoupled/actor_lag_p95": lag.get("actor_lag_p95", 0.0),
             "decoupled/actor_lag_max": lag.get("actor_lag_max", 0.0),
@@ -323,6 +330,7 @@ class DecoupledTrainer(Trainer):
             "done": np.zeros((count, n), np.float32),
             "generation": np.zeros((count,), np.int64),
             "epoch": np.zeros((count,), np.int64),
+            "actor_id": np.zeros((count,), np.int64),
         }
 
     def _checkpoint_abstract_arrays(self, meta_probe: dict):

@@ -31,6 +31,14 @@ an accident:
   remote/threaded actor idle-spins until ``resume()`` reopens it —
   actors survive a learner restart without losing their own envs.
 
+- **Dead-actor purge** (``purge_actor``): when the fleet supervisor
+  declares an actor process dead (missed heartbeat deadline,
+  ``decoupled/fleet.py``), its not-yet-drained transitions are removed
+  and counted (``dropped_dead_actor_total``) — a dead actor's tail is
+  an explicit accounting entry, never silent residue trained on after
+  its producer was SIGKILL-reaped. Transitions carry the producing
+  ``actor_id`` (``-1`` = the learner's own inline actor).
+
 Per-transition **generation-lag accounting** rides the shared
 :class:`~torch_actor_critic_tpu.telemetry.histogram.
 FixedBucketHistogram` schema (``actor_lag`` on metrics.jsonl, epoch
@@ -38,10 +46,11 @@ telemetry events and ``/metrics``), so staleness is observable with
 the same estimator as every other histogram in the system.
 
 Conservation invariant (the "zero transitions lost" proof the chaos
-smoke asserts)::
+smoke asserts — now spanning process boundaries)::
 
     staged_total == drained_total + dropped_stale_total
-                    + dropped_backpressure_total + depth()
+                    + dropped_backpressure_total
+                    + dropped_dead_actor_total + depth()
 
 Everything here is deterministic and injectable (no hidden clocks): the
 only wait is the ``block`` policy's bounded condition wait.
@@ -76,11 +85,13 @@ class StagingUnavailable(RuntimeError):
 class StagedTransition(t.NamedTuple):
     """One staged lockstep step: the batched transition tuple
     ``(obs, actions, rewards, next_obs, done)`` (leading axis = envs)
-    plus the policy provenance tags."""
+    plus the policy provenance tags and the producing actor
+    (``actor_id=-1`` = the learner's inline actor)."""
 
     transition: tuple
     generation: int
     epoch: int | None
+    actor_id: int = -1
 
 
 class StagingBuffer:
@@ -114,6 +125,7 @@ class StagingBuffer:
         self.drained_total = 0  # guarded-by: _cond
         self.dropped_stale_total = 0  # guarded-by: _cond
         self.dropped_backpressure_total = 0  # guarded-by: _cond
+        self.dropped_dead_actor_total = 0  # guarded-by: _cond
         self.shed_total = 0  # guarded-by: _cond
         self.blocked_total = 0  # guarded-by: _cond
         self.lag_hist = FixedBucketHistogram(  # guarded-by: _cond
@@ -128,6 +140,7 @@ class StagingBuffer:
         generation: int = 0,
         epoch: int | None = None,
         timeout_s: float | None = None,
+        actor_id: int = -1,
     ) -> bool:
         """Stage one tagged transition; returns True when accepted.
 
@@ -178,6 +191,7 @@ class StagingBuffer:
                 StagedTransition(
                     transition, int(generation),
                     int(epoch) if epoch is not None else None,
+                    int(actor_id),
                 )
             )
             self.staged_total += 1
@@ -225,6 +239,22 @@ class StagingBuffer:
             self._cond.notify_all()
             return out
 
+    def purge_actor(self, actor_id: int) -> int:
+        """Drop every staged transition produced by ``actor_id``
+        (counted ``dropped_dead_actor_total``); returns how many were
+        purged. The fleet supervisor calls this when it declares an
+        actor process dead — the orphaned tail leaves the buffer as an
+        explicit conservation entry, not as training data from a
+        producer that no longer exists."""
+        with self._cond:
+            kept = [e for e in self._q if e.actor_id != int(actor_id)]
+            n_purged = len(self._q) - len(kept)
+            if n_purged:
+                self.dropped_dead_actor_total += n_purged
+                self._q = collections.deque(kept)
+                self._cond.notify_all()
+            return n_purged
+
     # ------------------------------------------------------ pause/resume
 
     def pause(self) -> None:
@@ -266,6 +296,7 @@ class StagingBuffer:
                 "dropped_stale_total": self.dropped_stale_total,
                 "dropped_backpressure_total":
                     self.dropped_backpressure_total,
+                "dropped_dead_actor_total": self.dropped_dead_actor_total,
                 "shed_total": self.shed_total,
                 "blocked_total": self.blocked_total,
                 "actor_lag": self.lag_hist.snapshot(
@@ -281,6 +312,7 @@ class StagingBuffer:
                 self.drained_total
                 + self.dropped_stale_total
                 + self.dropped_backpressure_total
+                + self.dropped_dead_actor_total
                 + len(self._q)
             )
 
@@ -298,6 +330,7 @@ class StagingBuffer:
                 "dropped_stale_total": self.dropped_stale_total,
                 "dropped_backpressure_total":
                     self.dropped_backpressure_total,
+                "dropped_dead_actor_total": self.dropped_dead_actor_total,
                 "shed_total": self.shed_total,
                 "blocked_total": self.blocked_total,
                 "lag_hist": self.lag_hist.raw_counts(),
@@ -312,6 +345,9 @@ class StagingBuffer:
             )
             self.dropped_backpressure_total = int(
                 meta.get("dropped_backpressure_total", 0)
+            )
+            self.dropped_dead_actor_total = int(
+                meta.get("dropped_dead_actor_total", 0)
             )
             self.shed_total = int(meta.get("shed_total", 0))
             self.blocked_total = int(meta.get("blocked_total", 0))
@@ -343,6 +379,9 @@ class StagingBuffer:
         out["epoch"] = np.asarray(
             [-1 if e.epoch is None else e.epoch for e in entries], np.int64
         )
+        out["actor_id"] = np.asarray(
+            [e.actor_id for e in entries], np.int64
+        )
         return out
 
     def import_arrays(self, arrays: t.Mapping[str, t.Any]) -> int:
@@ -354,6 +393,12 @@ class StagingBuffer:
         generations = np.asarray(arrays["generation"])
         epochs = np.asarray(arrays["epoch"])
         count = int(generations.shape[0])
+        # Pre-fleet checkpoints carry no actor_id item: everything
+        # staged then was the learner's inline actor (-1).
+        actor_ids = (
+            np.asarray(arrays["actor_id"]) if "actor_id" in arrays
+            else np.full((count,), -1, np.int64)
+        )
         entries = []
         for i in range(count):
             txn = tuple(
@@ -365,7 +410,8 @@ class StagingBuffer:
             ep = int(epochs[i])
             entries.append(
                 StagedTransition(txn, int(generations[i]),
-                                 None if ep < 0 else ep)
+                                 None if ep < 0 else ep,
+                                 int(actor_ids[i]))
             )
         with self._cond:
             self._q = collections.deque(entries)

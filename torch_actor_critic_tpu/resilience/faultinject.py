@@ -17,6 +17,16 @@ on CPU:
 - **Env-worker death** — :func:`kill_env_worker` SIGKILLs a
   :class:`ParallelEnvPool` worker and *joins* it, so the next pool op
   deterministically observes a dead (not "maybe-dead") worker.
+- **Actor-process death** — :func:`kill_actor` SIGKILLs a fleet actor
+  (by supervisor slot index or raw pid) and joins it, so the
+  supervisor's next liveness poll deterministically sees the corpse —
+  the restart/purge/dedup chain of ``decoupled/fleet.py`` runs against
+  a provably-dead process.
+- **Staging-transport flap** — :class:`FlakyTransport` wraps the
+  actor's staging POST callable (the :class:`RemoteStagingClient`
+  ``post`` seam) with scheduled connection drops and latency — the
+  LossyLink pattern moved to the push path, driving the retry/backoff
+  + sequence-number dedup machinery instead of the acting path.
 - **Checkpoint IO faults** — :func:`make_flaky` wraps any callable to
   fail its first N calls (transient-IO retry path);
   :func:`corrupt_checkpoint` damages an on-disk Orbax step the way an
@@ -52,7 +62,9 @@ import numpy as np
 __all__ = [
     "FaultyEnvPool",
     "FaultyEngine",
+    "FlakyTransport",
     "LossyLink",
+    "kill_actor",
     "kill_env_worker",
     "make_flaky",
     "corrupt_checkpoint",
@@ -266,6 +278,109 @@ class LossyLink:
 
     def __getattr__(self, name: str):
         return getattr(self._client, name)
+
+
+class FlakyTransport:
+    """Lossy/slow staging-push link: the LossyLink pattern moved from
+    the acting path to the transport POST path (docs/RESILIENCE.md
+    "Decoupled-plane failure modes", transport-flap row).
+
+    Wraps the :class:`~torch_actor_critic_tpu.decoupled.transport.
+    RemoteStagingClient` ``post`` callable (``post(path, payload,
+    timeout_s) -> (status, body)``) and, per call, injects configurable
+    **latency** (``latency_s``, via the injectable ``sleep``) and
+    **drops** — a dropped call raises ``ConnectionError`` (an
+    ``OSError``, what a real dead link surfaces through urllib), so the
+    client's jittered retry/backoff + the server's sequence-number
+    dedup run, not a special test path. Drops are probabilistic
+    (``drop_rate`` with a seedable ``rng``) or exactly scheduled
+    (:meth:`drop_next`). Inject either directly::
+
+        client._post = FlakyTransport(client._post, drop_rate=0.3)
+
+    or, for spawned fleet actors, via the ``TAC_FLAKY_PUSH`` env var
+    (``"drop_rate=0.3,latency_s=0.01,seed=0"`` — decoupled/fleet.py),
+    which is how the chaos smoke flaps the whole fleet's push path.
+    """
+
+    def __init__(
+        self,
+        post: t.Callable,
+        drop_rate: float = 0.0,
+        latency_s: float = 0.0,
+        rng=None,
+        sleep: t.Callable[[float], None] = None,
+    ):
+        if not 0.0 <= drop_rate <= 1.0:
+            raise ValueError(f"drop_rate must be in [0, 1], got {drop_rate}")
+        import random as _random
+        import time as _time
+
+        self._post = post
+        self.drop_rate = float(drop_rate)
+        self.latency_s = float(latency_s)
+        self._rng = rng if rng is not None else _random.Random()
+        self._sleep = sleep if sleep is not None else _time.sleep
+        self._drop_left = 0
+        self.calls_total = 0
+        self.drops_injected = 0
+        self.latency_injected_s = 0.0
+
+    def drop_next(self, n: int) -> "FlakyTransport":
+        """Deterministically drop the next ``n`` POSTs (cumulative;
+        takes precedence over ``drop_rate``)."""
+        self._drop_left += int(n)
+        return self
+
+    def __call__(self, path: str, payload: dict, timeout_s: float):
+        self.calls_total += 1
+        if self.latency_s > 0.0:
+            self.latency_injected_s += self.latency_s
+            self._sleep(self.latency_s)
+        dropped = False
+        if self._drop_left > 0:
+            self._drop_left -= 1
+            dropped = True
+        elif self.drop_rate > 0.0 and self._rng.random() < self.drop_rate:
+            dropped = True
+        if dropped:
+            self.drops_injected += 1
+            raise ConnectionError(
+                "injected flaky transport: POST dropped in flight "
+                f"({path}, call {self.calls_total})"
+            )
+        return self._post(path, payload, timeout_s)
+
+
+def kill_actor(
+    target: t.Any, idx: int | None = None, join_timeout_s: float = 10.0
+) -> int:
+    """SIGKILL a fleet actor process and reap it.
+
+    ``target`` is either a :class:`~torch_actor_critic_tpu.decoupled.
+    fleet.FleetSupervisor` with ``idx`` naming the actor slot, or a raw
+    pid (``idx`` omitted). Joining before returning makes the death
+    *observable*: the supervisor's next liveness poll deterministically
+    finds a dead process (not a maybe-dead one), so the
+    kill→purge→restart→dedup chain is step-synchronized in tests.
+    Returns the killed pid.
+    """
+    if idx is not None:
+        with target._lock:
+            proc = target._procs.get(idx)
+        if proc is None:
+            raise ValueError(f"supervisor has no live actor in slot {idx}")
+        pid = proc.pid
+        os.kill(pid, signal.SIGKILL)
+        proc.join(timeout=join_timeout_s)
+        if proc.is_alive():  # pragma: no cover — SIGKILL cannot be blocked
+            raise RuntimeError(f"actor {idx} (pid {pid}) survived SIGKILL")
+        return pid
+    pid = int(target)
+    os.kill(pid, signal.SIGKILL)
+    # Raw-pid mode: not our child (e.g. the smoke killing across a
+    # process boundary) — waitpid would raise; the kernel reaps it.
+    return pid
 
 
 def nan_params(params: t.Any, fraction_leaf: int = 0) -> t.Any:

@@ -362,10 +362,25 @@ def main(argv=None):
     # "Decoupled-plane failure modes"). Resume picks the class from the
     # run's stored config, so `--run <id>` restarts land on the right
     # plane automatically.
-    if config.decoupled:
+    if config.actors > 0:
+        # --actors N: the supervised process fleet (decoupled/fleet.py)
+        # — N ActorWorker subprocesses over the networked staging
+        # transport, heartbeat-supervised with bounded restarts, on top
+        # of the same decoupled learner.
+        from torch_actor_critic_tpu.decoupled import FleetTrainer
+
+        trainer_cls: type = FleetTrainer
+        logger.info(
+            "actor fleet: %d supervised actor processes, "
+            "max_restarts=%d, heartbeat=%.2fs/%.2fs, staging=%d (%s)",
+            config.actors, config.actor_max_restarts,
+            config.heartbeat_interval_s, config.heartbeat_timeout_s,
+            config.resolved_staging_capacity, config.staging_policy,
+        )
+    elif config.decoupled:
         from torch_actor_critic_tpu.decoupled import DecoupledTrainer
 
-        trainer_cls: type = DecoupledTrainer
+        trainer_cls = DecoupledTrainer
         logger.info(
             "decoupled actor/learner: serving=%s, max_actor_lag=%d, "
             "staging=%d (%s)",

@@ -273,6 +273,37 @@ class SACConfig:
     # degrades to its local param snapshot instead of stalling envs.
     actor_timeout_s: float = 5.0
 
+    # --- actor-process fleet (decoupled/fleet.py, docs/RESILIENCE.md
+    # "Decoupled-plane failure modes") ---
+    # N > 0 spawns N supervised ActorWorker subprocesses on their own
+    # env pools, acting through the learner's serving plane and pushing
+    # transitions over the networked staging transport (HTTP, per-actor
+    # monotonic sequence numbers for idempotent ingestion). Implies
+    # decoupled=True. 0 = no fleet (inline actor only).
+    actors: int = 0
+    # Restart budget per actor slot: a dead actor (process exit or
+    # missed heartbeat deadline) is SIGKILL-reaped, its staged tail
+    # purged (dropped_dead_actor_total), and respawned with jittered
+    # exponential backoff up to this many times; past it the slot is
+    # abandoned and the fleet trains on the survivors.
+    actor_max_restarts: int = 3
+    # Actors POST /heartbeat every interval; the supervisor declares an
+    # actor dead when its newest heartbeat is older than the timeout.
+    # The timeout must exceed the interval with slack for scheduling
+    # jitter (CPU CI boxes stall; 6x is a sane floor).
+    heartbeat_interval_s: float = 0.5
+    heartbeat_timeout_s: float = 3.0
+    # Actor-side staging-push retry budget: transient failures (refused
+    # connections, 5xx, learner checkpoint pauses) are retried with
+    # jittered exponential backoff within this budget, then the actor
+    # degrades to local acting and re-homes on recovery (PR-10
+    # semantics across the wire).
+    actor_push_retry_s: float = 2.0
+    # Transport bind port for the staging/heartbeat/act endpoint;
+    # 0 = ephemeral (the chaos smoke pins a port so a resumed learner
+    # rebinds the same address and live actors reconnect).
+    fleet_port: int = 0
+
     # --- tiered replay + offline training (replay/, docs/REPLAY.md) ---
     # Tier stack under the HBM ring: "off" (parity default — no host
     # mirroring, no extra metric keys, jit cache and replay stream
@@ -480,6 +511,41 @@ class SACConfig:
         if self.actor_timeout_s <= 0:
             raise ValueError(
                 f"actor_timeout_s must be > 0, got {self.actor_timeout_s}"
+            )
+        if self.actors < 0:
+            raise ValueError(
+                f"actors must be >= 0 (0 = no fleet), got {self.actors}"
+            )
+        if self.actors > 0:
+            # --actors N is a decoupled-plane feature: the fleet feeds
+            # the StagingBuffer and the learner's serving plane, so the
+            # flag implies the split rather than erroring on it.
+            self.decoupled = True
+        if self.actor_max_restarts < 0:
+            raise ValueError(
+                f"actor_max_restarts must be >= 0, got "
+                f"{self.actor_max_restarts}"
+            )
+        if self.heartbeat_interval_s <= 0:
+            raise ValueError(
+                f"heartbeat_interval_s must be > 0, got "
+                f"{self.heartbeat_interval_s}"
+            )
+        if self.heartbeat_timeout_s <= self.heartbeat_interval_s:
+            raise ValueError(
+                f"heartbeat_timeout_s ({self.heartbeat_timeout_s}) must "
+                f"exceed heartbeat_interval_s "
+                f"({self.heartbeat_interval_s}); one missed beat is "
+                "scheduling jitter, not death"
+            )
+        if self.actor_push_retry_s <= 0:
+            raise ValueError(
+                f"actor_push_retry_s must be > 0, got "
+                f"{self.actor_push_retry_s}"
+            )
+        if not (0 <= self.fleet_port <= 65535):
+            raise ValueError(
+                f"fleet_port must be in [0, 65535], got {self.fleet_port}"
             )
         if self.decoupled:
             if self.on_device:
