@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke shard-serve-smoke decouple-smoke visual-smoke scenario-smoke sanitize-smoke replay-smoke
+.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke shard-serve-smoke decouple-smoke visual-smoke scenario-smoke sanitize-smoke replay-smoke coldstart-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -176,6 +176,17 @@ scenario-smoke:
 # chunks to a saved checkpoint (docs/REPLAY.md).
 replay-smoke:
 	JAX_PLATFORMS=cpu python scripts/replay_smoke.py
+
+# Cold-start smoke (CPU, real CLI): build a warm-start bundle next to
+# a real checkpoint (aot/), then prove against fresh serve.py workers
+# that the bundle answers the first /act with ZERO serve-plane live
+# compiles and holds zero through a closed-loop herd flood, that a
+# second worker hits the shared persistent compile cache, and that a
+# fingerprint-tampered bundle is loudly rejected with a counted
+# fallback to live warmup (docs/SERVING.md "Cold start & warm-start
+# bundles").
+coldstart-smoke:
+	JAX_PLATFORMS=cpu python scripts/coldstart_smoke.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -2413,6 +2413,182 @@ def bench_actor_fleet(budget_s=240.0, sizes=(1, 2, 4), max_actor_lag=4):
     return out
 
 
+def bench_coldstart(budget_s=420.0, trials=2):
+    """Cold-start latency (docs/SERVING.md "Cold start & warm-start
+    bundles"): time-to-first-act of a FRESH ``serve.py`` worker process
+    without vs with a warm-start bundle (aot/bundle.py) and its
+    pre-populated persistent compilation cache. Each point spawns the
+    real operator CLI against a real checkpoint and times
+    spawn -> ready (startup JSON line) and spawn -> first completed
+    ``/act`` round-trip; the bundle rows read ``/metrics`` back to pin
+    the serve-plane compile counters (``live_compiles`` must be 0 when
+    the bundle loads). The ``*_ms`` keys ride bench-diff's existing
+    lower-is-better direction; ``coldstart_speedup`` and
+    ``cache_hit_rate`` are higher-better."""
+    import shutil
+    import tempfile
+    from urllib import request as urlreq
+
+    import jax
+    import jax.numpy as jnp
+
+    from torch_actor_critic_tpu.aot import emit_bundle
+    from torch_actor_critic_tpu.models import Actor, DoubleCritic
+    from torch_actor_critic_tpu.sac import SAC
+    from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
+    from torch_actor_critic_tpu.utils.config import SACConfig
+
+    t_start = time.time()
+    max_batch = 8
+    tmp = tempfile.mkdtemp(prefix="bench_coldstart_")
+    ckpt_dir = os.path.join(tmp, "ckpts")
+    cfg = SACConfig(hidden_sizes=(32, 32))
+    sac = SAC(cfg, Actor(act_dim=ACT_DIM, hidden_sizes=(32, 32)),
+              DoubleCritic(hidden_sizes=(32, 32)), ACT_DIM)
+    state = sac.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
+    ck = Checkpointer(ckpt_dir, save_buffer=False)
+    ck.save(0, state, extra={"config": cfg.to_json()}, wait=True)
+    ck.close()
+
+    t0 = time.time()
+    emit_bundle(
+        ckpt_dir, sac.actor_def,
+        jax.ShapeDtypeStruct((OBS_DIM,), jnp.float32),
+        jax.device_get(state.actor_params), max_batch=max_batch,
+    )
+    out: dict = {
+        "config": {"hidden": [32, 32], "max_batch": max_batch,
+                   "trials": trials},
+        "bundle_build_s": round(time.time() - t0, 2),
+    }
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    if jax.default_backend() == "cpu":
+        # Same subprocess hygiene as scripts/serve_smoke.py: the bundle
+        # fingerprint was minted on CPU, so the worker must come up on
+        # CPU too or every warm row silently measures the fallback.
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+
+    def measure(extra, label):
+        """Spawn one fresh worker; time ready + first /act; read the
+        compile counters back; always reap the subprocess."""
+        argv = [
+            sys.executable, os.path.join(repo, "serve.py"),
+            "--ckpt-dir", ckpt_dir,
+            "--obs-dim", str(OBS_DIM), "--act-dim", str(ACT_DIM),
+            "--port", "0", "--max-batch", str(max_batch),
+            "--max-wait-ms", "2",
+        ] + extra
+        t_spawn = time.time()
+        proc = subprocess.Popen(
+            argv, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, cwd=repo,
+        )
+        try:
+            address, deadline = None, time.time() + 240
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    if proc.poll() is not None:
+                        raise RuntimeError(
+                            f"{label}: worker died rc={proc.returncode}"
+                        )
+                    time.sleep(0.05)
+                    continue
+                if line.startswith("{"):
+                    try:
+                        address = json.loads(line)["serving"]
+                        break
+                    except (json.JSONDecodeError, KeyError):
+                        continue
+            if address is None:
+                raise RuntimeError(f"{label}: worker never became ready")
+            ready_s = time.time() - t_spawn
+            req = urlreq.Request(
+                address + "/act",
+                data=json.dumps(
+                    {"obs": [0.0] * OBS_DIM, "deterministic": True}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            urlreq.urlopen(req, timeout=60).read()
+            first_act_s = time.time() - t_spawn
+            met = json.loads(
+                urlreq.urlopen(address + "/metrics", timeout=30).read()
+            )
+            xla = met.get("xla", {})
+            row = {
+                "ready_ms": round(ready_s * 1e3, 1),
+                "first_act_ms": round(first_act_s * 1e3, 1),
+                "live_compiles": met.get("live_compiles"),
+                "bundle_compiles": met.get("bundle_compiles"),
+                "warmup_compiles": xla.get("warmup_compiles"),
+                "bundle_load_compiles": xla.get("bundle_load_compiles"),
+                "bundle_hits": xla.get("bundle_hits"),
+                "bundle_rejected": xla.get("bundle_rejected"),
+                "cache_hits": xla.get("cache_hits_total"),
+                "cache_misses": xla.get("cache_misses_total"),
+            }
+            hits, misses = row["cache_hits"], row["cache_misses"]
+            if hits is not None and misses is not None and hits + misses:
+                row["cache_hit_rate"] = round(hits / (hits + misses), 3)
+            return row
+        finally:
+            proc.terminate()
+            try:
+                proc.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    # ABBA order like the overhead stages: host drift (page cache,
+    # thermal) cancels to first order across the cold/warm pairs.
+    rows: dict = {"cold": [], "warm": []}
+    for label in (["cold", "warm", "warm", "cold"] * trials)[: 2 * trials]:
+        if (time.time() - t_start > budget_s
+                and rows["cold"] and rows["warm"]):
+            break
+        extra = ["--warm-start", "auto"] if label == "warm" else []
+        try:
+            row = measure(extra, label)
+            rows[label].append(row)
+            log_point("coldstart", dict(row, variant=label))
+        except Exception as e:  # noqa: BLE001 — per-trial best effort
+            out.setdefault("errors", []).append(f"{label}: {e!r}"[:200])
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    best_cold = best_warm = None
+    if rows["cold"]:
+        best_cold = min(rows["cold"], key=lambda r: r["first_act_ms"])
+        out["cold"] = best_cold
+        out["cold_first_act_ms"] = best_cold["first_act_ms"]
+    if rows["warm"]:
+        best_warm = min(rows["warm"], key=lambda r: r["first_act_ms"])
+        out["warm"] = best_warm
+        out["warm_first_act_ms"] = best_warm["first_act_ms"]
+        out["warm_live_compiles"] = best_warm["live_compiles"]
+        if best_warm.get("cache_hit_rate") is not None:
+            out["cache_hit_rate"] = best_warm["cache_hit_rate"]
+    if best_cold and best_warm:
+        out["coldstart_speedup"] = round(
+            best_cold["first_act_ms"]
+            / max(best_warm["first_act_ms"], 1e-9), 2
+        )
+        # The acceptance pin, recorded in the artifact itself: a fresh
+        # worker answering its first /act off the bundle paid ZERO live
+        # compiles (and really loaded the bundle — not the fallback).
+        out["zero_live_compiles_with_bundle"] = bool(
+            best_warm["live_compiles"] == 0
+            and (best_warm["bundle_compiles"] or 0) > 0
+        )
+    log(f"coldstart: {out}")
+    return out
+
+
 def bench_diagnostics_overhead(budget_s=540.0):
     """Learning-health diagnostics cost (docs/OBSERVABILITY.md
     "Learning-health diagnostics"): steady-state Trainer throughput at
@@ -2606,6 +2782,12 @@ _STAGES = {
         "actor_fleet": bench_actor_fleet(
             budget_s=stage_budget(240.0)
         ),
+    },
+    # Time-to-first-act of a fresh serve.py worker with vs without a
+    # warm-start bundle (aot/; docs/SERVING.md "Cold start &
+    # warm-start bundles").
+    "coldstart": lambda: {
+        "coldstart": bench_coldstart(budget_s=stage_budget(420.0))
     },
     "host_envs": lambda: {"host_envs": bench_host_envs()},
     "telemetry_overhead": lambda: {
@@ -2979,6 +3161,19 @@ def main():
     )
     if res and "error" in res:
         diagnostics.append({"decoupled_stage_error": res.pop("error")})
+    if res:
+        out.update(res)
+
+    # 5a'''''. Cold start (docs/SERVING.md "Cold start & warm-start
+    # bundles"): time-to-first-act of a fresh serve.py worker with vs
+    # without a warm-start bundle + pre-populated compile cache,
+    # through the real operator CLI. Same backend as the serving
+    # stages (the fingerprint pins bundle and worker to one platform).
+    res = run_stage_subprocess(
+        "coldstart", 600, diagnostics, platform=serving_platform
+    )
+    if res and "error" in res:
+        diagnostics.append({"coldstart_stage_error": res.pop("error")})
     if res:
         out.update(res)
 

@@ -35,7 +35,10 @@ import sys
 HIGHER_BETTER = re.compile(
     r"(per_sec|_rps$|tflops|^mfu$|_mfu$|^est_mfu$|goodput|occupancy"
     r"|^value$|^value_bf16$|scaling_vs_1|roofline_frac|gflops_s$"
-    r"|hbm_util$)"
+    # Cold-start stage (bench_coldstart): bundle speedup and the
+    # persistent-compile-cache hit rate; its *_ms keys (ready_ms /
+    # first_act_ms) already ride the lower-better _ms$ direction.
+    r"|hbm_util$|_speedup$|hit_rate$)"
 )
 LOWER_BETTER = re.compile(
     r"(^p50_ms$|^p95_ms$|^p99_ms$|^mean_ms$|^max_ms$|_ms$"

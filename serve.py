@@ -95,6 +95,23 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                           "bf16 runs matmuls at the MXU's native "
                           "width; int8 serves per-channel "
                           "weight-quantized params (dequant in-graph)")
+    aot = p.add_argument_group("cold start (docs/SERVING.md)")
+    aot.add_argument("--warm-start", metavar="DIR", default=None,
+                     help="Warm-start bundle dir (aot/bundle.py), or "
+                          "'auto' for the checkpoint-adjacent default "
+                          "(<ckpt parent>/warm_start). Warmup loads "
+                          "pre-compiled executables from the bundle's "
+                          "persistent cache so the first /act pays "
+                          "ZERO live compiles; a fingerprint-"
+                          "mismatched bundle is rejected loudly "
+                          "(watchdog bundle_rejected) and serving "
+                          "falls back to live compile")
+    aot.add_argument("--compile-cache", metavar="DIR", default=None,
+                     help="Persistent XLA compilation cache dir "
+                          "(aot/cache.py) shared across processes — "
+                          "fleet workers and restarts compile once "
+                          "fleet-wide. Overrides the bundle's own "
+                          "cache when both are given")
     flt = p.add_argument_group("fleet (multi-process)")
     flt.add_argument("--fleet", type=int, default=0,
                      help="Spawn N serve.py worker processes and front "
@@ -104,6 +121,13 @@ def parse_arguments(argv=None) -> argparse.Namespace:
     flt.add_argument("--router-poll", type=float, default=1.0,
                      help="Fleet membership /healthz poll interval "
                           "seconds")
+    flt.add_argument("--warm-pool", type=int, default=0,
+                     help="Keep N pre-forked WARM spare workers "
+                          "(booted, warmed — from the bundle when "
+                          "--warm-start is set) ready behind the "
+                          "router; a dead worker is replaced by "
+                          "drawing a spare instead of paying "
+                          "spawn+compile (aot/prefork.py)")
     srv.add_argument("--buckets", type=str, default=None,
                      help="Comma-separated bucket sizes (default: powers "
                           "of two up to max-batch)")
@@ -251,10 +275,12 @@ def _worker_argv(argv, worker: int | None = None):
         if skip:
             skip = False
             continue
-        if a in ("--fleet", "--port", "--router-poll"):
+        if a in ("--fleet", "--port", "--router-poll", "--warm-pool"):
             skip = True
             continue
-        if a.split("=", 1)[0] in ("--fleet", "--port", "--router-poll"):
+        if a.split("=", 1)[0] in (
+            "--fleet", "--port", "--router-poll", "--warm-pool"
+        ):
             continue
         out.append(a)
     if worker is not None:
@@ -269,6 +295,58 @@ def _worker_argv(argv, worker: int | None = None):
     return out + ["--port", "0"]
 
 
+def _await_worker_ready(proc, idx: int, timeout_s: float = 300.0):
+    """Read the worker's startup JSON line off its stdout and return
+    its serving address; raises RuntimeError if the worker dies or
+    stays silent past the deadline. On success a daemon pump thread
+    keeps draining the pipe (a full pipe would wedge the worker)."""
+    import threading
+    import time
+
+    address, deadline = None, time.time() + timeout_s
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet worker {idx} exited rc={proc.returncode} "
+                    "before becoming ready"
+                )
+            time.sleep(0.1)
+            continue
+        if line.startswith("{"):
+            try:
+                address = json.loads(line)["serving"]
+                break
+            except (json.JSONDecodeError, KeyError):
+                continue
+    if address is None:
+        raise RuntimeError(f"fleet worker {idx} never printed its address")
+
+    def _pump(stream=proc.stdout, i=idx):
+        for out_line in stream:
+            logger.debug("worker %d: %s", i, out_line.rstrip())
+
+    threading.Thread(target=_pump, daemon=True).start()
+    return address
+
+
+def _spawn_worker(argv, idx: int):
+    """Launch one serve.py worker subprocess (ephemeral port) — the
+    spawn half of warm-pool/replacement spawns; readiness is awaited
+    separately (or by the caller via _await_worker_ready)."""
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    return subprocess.Popen(
+        [sys.executable, os.path.join(here, "serve.py")]
+        + _worker_argv(argv, worker=idx),
+        stdout=subprocess.PIPE, stderr=None, text=True, cwd=here,
+    )
+
+
 def run_fleet(args, argv):
     """``--fleet N``: spawn N workers, front them with the router.
 
@@ -278,55 +356,24 @@ def run_fleet(args, argv):
     SIGTERM to THIS process rolls the whole fleet down gracefully:
     workers get SIGTERM (their drain answers everything accepted),
     then the router stops. A worker dying on its own is NOT fatal —
-    membership ejects it and the survivors keep serving."""
-    import os
+    membership ejects it and the survivors keep serving; with
+    ``--warm-pool N`` a pre-forked warm spare (already listening and
+    warmed, from the bundle when ``--warm-start`` is set) is drawn to
+    replace it, so kill-replacement costs a queue-pop instead of
+    spawn+compile."""
+    import itertools
     import signal
     import subprocess
-    import sys
     import threading
-    import time
 
     from torch_actor_critic_tpu.serve.router import FleetRouter
 
-    here = os.path.dirname(os.path.abspath(__file__))
-    workers, pumps = [], []
+    workers, worker_lock = [], threading.Lock()
     for i in range(args.fleet):
-        proc = subprocess.Popen(
-            [sys.executable, os.path.join(here, "serve.py")]
-            + _worker_argv(argv, worker=i),
-            stdout=subprocess.PIPE, stderr=None, text=True, cwd=here,
-        )
-        workers.append(proc)
-    addresses = []
-    for i, proc in enumerate(workers):
-        address, deadline = None, time.time() + 300
-        while time.time() < deadline:
-            line = proc.stdout.readline()
-            if not line:
-                if proc.poll() is not None:
-                    raise SystemExit(
-                        f"fleet worker {i} exited rc={proc.returncode} "
-                        "before becoming ready"
-                    )
-                time.sleep(0.1)
-                continue
-            if line.startswith("{"):
-                try:
-                    address = json.loads(line)["serving"]
-                    break
-                except (json.JSONDecodeError, KeyError):
-                    continue
-        if address is None:
-            raise SystemExit(f"fleet worker {i} never printed its address")
-        addresses.append(address)
-
-        def _pump(stream=proc.stdout, idx=i):
-            for out_line in stream:
-                logger.debug("worker %d: %s", idx, out_line.rstrip())
-
-        th = threading.Thread(target=_pump, daemon=True)
-        th.start()
-        pumps.append(th)
+        workers.append(_spawn_worker(argv, i))
+    addresses = [
+        _await_worker_ready(proc, i) for i, proc in enumerate(workers)
+    ]
     logger.info("fleet up: %d workers %s", len(addresses), addresses)
 
     span_log = None
@@ -342,12 +389,73 @@ def run_fleet(args, argv):
     )
     router.poll_once()
 
-    def _teardown(signum=None, frame=None):
-        logger.info("fleet teardown: draining %d workers", len(workers))
-        for proc in workers:
+    # Pre-forked warm spares (aot/prefork.py): each spare is a fully
+    # booted, warmed worker waiting off-rotation; the monitor below
+    # draws one the moment a live worker dies.
+    pool = None
+    monitor_stop = threading.Event()
+    if args.warm_pool > 0:
+        from torch_actor_critic_tpu.aot import WarmPool
+
+        spare_idx = itertools.count(args.fleet)
+
+        def _spawn_spare():
+            idx = next(spare_idx)
+            proc = _spawn_worker(argv, idx)
+            return proc, _await_worker_ready(proc, idx)
+
+        def _kill_worker(proc):
             if proc.poll() is None:
                 proc.send_signal(signal.SIGTERM)
-        for proc in workers:
+                try:
+                    proc.wait(timeout=args.drain_timeout + 30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+        pool = WarmPool(_spawn_spare, _kill_worker, size=args.warm_pool)
+
+        def _monitor():
+            handled = set()
+            while not monitor_stop.wait(max(args.router_poll, 0.2)):
+                with worker_lock:
+                    dead = [
+                        p for p in workers
+                        if p.poll() is not None and id(p) not in handled
+                    ]
+                for proc in dead:
+                    handled.add(id(proc))
+                    drawn = pool.draw(timeout=30.0)
+                    if drawn is None:
+                        logger.warning(
+                            "worker pid %d died and no warm spare was "
+                            "ready; relying on surviving workers",
+                            proc.pid,
+                        )
+                        continue
+                    with worker_lock:
+                        workers.append(drawn.handle)
+                    name = router.add_worker(drawn.address)
+                    logger.info(
+                        "worker pid %d died; warm spare admitted as %s "
+                        "at %s (pool: %s)",
+                        proc.pid, name, drawn.address, pool.stats(),
+                    )
+
+        threading.Thread(
+            target=_monitor, name="warm-pool-monitor", daemon=True
+        ).start()
+
+    def _teardown(signum=None, frame=None):
+        monitor_stop.set()
+        if pool is not None:
+            pool.shutdown()
+        with worker_lock:
+            procs = list(workers)
+        logger.info("fleet teardown: draining %d workers", len(procs))
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs:
             try:
                 proc.wait(timeout=args.drain_timeout + 30)
             except subprocess.TimeoutExpired:
@@ -356,12 +464,15 @@ def run_fleet(args, argv):
 
     signal.signal(signal.SIGTERM, lambda s, f: threading.Thread(
         target=_teardown, daemon=True).start())
+    with worker_lock:
+        pids = [proc.pid for proc in workers]
     print(json.dumps({
         "router": router.address,
         "workers": dict(zip(
             (f"w{i}" for i in range(len(addresses))), addresses
         )),
-        "pids": [proc.pid for proc in workers],
+        "pids": pids,
+        "warm_pool": pool.stats() if pool is not None else None,
     }), flush=True)
     try:
         router.serve_forever()
@@ -402,6 +513,46 @@ def main(argv=None):
     buckets = (
         [int(b) for b in args.buckets.split(",")] if args.buckets else None
     )
+
+    # Cold-start machinery (docs/SERVING.md "Cold start & warm-start
+    # bundles"): arm the persistent compilation cache and load the
+    # warm-start bundle BEFORE any engine is built, so every serve
+    # program this process compiles either hits the cache or is
+    # persisted for the next worker. An incompatible bundle is
+    # rejected loudly + counted, never trusted.
+    bundle = None
+    if args.warm_start:
+        from torch_actor_critic_tpu.aot import (
+            BundleMismatchError,
+            default_bundle_dir,
+            load_bundle,
+        )
+        from torch_actor_critic_tpu.diagnostics.watchdog import get_watchdog
+
+        bundle_dir = (
+            default_bundle_dir(ckpt_dir) if args.warm_start == "auto"
+            else args.warm_start
+        )
+        try:
+            bundle = load_bundle(bundle_dir)
+            bundle.check()
+        except FileNotFoundError as e:
+            logger.warning("no warm-start bundle: %s", e)
+            bundle = None
+        except BundleMismatchError as e:
+            get_watchdog().note_bundle_rejected(str(bundle_dir) + ": " + e.reason)
+            bundle = None
+    if args.compile_cache:
+        from torch_actor_critic_tpu.aot import enable_persistent_cache
+
+        enable_persistent_cache(args.compile_cache)
+    elif bundle is not None:
+        from torch_actor_critic_tpu.aot import enable_persistent_cache
+
+        # The bundle's own pre-populated cache: reads make warmup
+        # compile-free; writes (boot-time host programs) accrete for
+        # the next worker consuming the same bundle.
+        enable_persistent_cache(bundle.cache_dir, export_env=False)
 
     try:
         tp, fsdp = (int(x) for x in args.submesh.lower().split("x"))
@@ -448,6 +599,10 @@ def main(argv=None):
         # fleet below) serve every forward; warming the registry's
         # single-device engine too would just buy unused compiles.
         warmup=not sharded,
+        # Sharded programs are honestly NOT bundled (mesh-shaped
+        # executables; ENTRY_POINT_CONTRACTS bundleable=False) — they
+        # ride the persistent cache only.
+        bundle=bundle if not sharded else None,
     )
     logger.info("model loaded: %s", info)
     if args.poll_interval > 0:

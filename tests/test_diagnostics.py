@@ -450,7 +450,7 @@ def test_engine_compile_counts_warmup_vs_live():
     eng2.act(params, np.zeros((4, 3), np.float32), deterministic=True)
     s2 = eng2.compile_stats()
     assert s2["live_compiles"] == 1
-    assert s2["buckets"]["4"] == {"warmup": 0, "live": 1}
+    assert s2["buckets"]["4"] == {"warmup": 0, "live": 1, "bundle": 0}
 
 
 def test_server_metrics_exposes_compiles_and_xla():

@@ -25,6 +25,10 @@ piece; :data:`ENTRY_POINT_CONTRACTS` makes the wiring a checked table:
   derives its shardings from the shared planners
   (``param_specs``/``fsdp_spec``/``named_param_shardings`` — directly
   or through one same-class helper hop).
+* ``stale-bundle-manifest`` — every row must carry an **explicit**
+  ``bundleable=`` literal (the AOT warm-start manifest,
+  ``aot/manifest.py``, is derived from this column): a new entry
+  point cannot ship without declaring whether it is AOT-bundled.
 
 All checks run on whole-package runs only (a fixture or single-file
 run cannot tell missing wiring from un-linted wiring).
@@ -67,6 +71,13 @@ class ContractRow(t.NamedTuple):
     sharded_builder: t.Tuple[str, str] | None  # (file, qualname) whose
     #                                     shardings must come from the
     #                                     shared planners
+    bundleable: bool                    # AOT warm-start manifest
+    #                                     column: True iff the program
+    #                                     is serialized into the
+    #                                     warm_start bundle
+    #                                     (aot/manifest.py reads this;
+    #                                     stale-bundle-manifest requires
+    #                                     it be an explicit literal)
 
 
 # The checked wiring table, one row per reachability.ENTRY_POINTS
@@ -78,6 +89,10 @@ ENTRY_POINT_CONTRACTS: t.Dict[str, ContractRow] = {
         register_fn=("sac/trainer.py", "Trainer._note_epoch_cost"),
         register_ref="burst_cost_name",
         sharded_builder=("parallel/dp.py", "DataParallelSAC._build_burst"),
+        # Train-plane programs ride the shared persistent compilation
+        # cache instead of the serialized bundle (their shapes depend
+        # on run config, not the fixed serve bucket ladder).
+        bundleable=False,
     ),
     "train/population_burst": ContractRow(
         name_file="parallel/population.py", name_attr="burst_cost_name",
@@ -85,6 +100,7 @@ ENTRY_POINT_CONTRACTS: t.Dict[str, ContractRow] = {
         register_fn=("sac/trainer.py", "Trainer._note_epoch_cost"),
         register_ref="burst_cost_name",
         sharded_builder=None,
+        bundleable=False,
     ),
     "train/ondevice_epoch": ContractRow(
         name_file="sac/ondevice.py", name_attr="epoch_cost_name",
@@ -92,6 +108,7 @@ ENTRY_POINT_CONTRACTS: t.Dict[str, ContractRow] = {
         register_fn=("sac/ondevice.py", "_note_epoch_cost"),
         register_ref="epoch_cost_name",
         sharded_builder=None,
+        bundleable=False,
     ),
     "train/population_epoch": ContractRow(
         name_file="sac/ondevice.py", name_attr="epoch_cost_name",
@@ -99,6 +116,7 @@ ENTRY_POINT_CONTRACTS: t.Dict[str, ContractRow] = {
         register_fn=("sac/ondevice.py", "_note_epoch_cost"),
         register_ref="epoch_cost_name",
         sharded_builder=None,
+        bundleable=False,
     ),
     "train/scenario_epoch": ContractRow(
         name_file="scenarios/loop.py", name_attr="epoch_cost_name",
@@ -106,6 +124,7 @@ ENTRY_POINT_CONTRACTS: t.Dict[str, ContractRow] = {
         register_fn=("sac/ondevice.py", "_note_epoch_cost"),
         register_ref="epoch_cost_name",
         sharded_builder=None,
+        bundleable=False,
     ),
     "replay/prefetch_push": ContractRow(
         name_file="replay/prefetch.py", name_attr="push_cost_name",
@@ -113,6 +132,7 @@ ENTRY_POINT_CONTRACTS: t.Dict[str, ContractRow] = {
         register_fn=("replay/prefetch.py", "RefillPrefetcher.maybe_register_cost"),
         register_ref="push_cost_name",
         sharded_builder=None,
+        bundleable=False,
     ),
     "train/offline_burst": ContractRow(
         name_file="replay/offline.py", name_attr="burst_cost_name",
@@ -120,6 +140,7 @@ ENTRY_POINT_CONTRACTS: t.Dict[str, ContractRow] = {
         register_fn=("replay/offline.py", "OfflineLearner.maybe_register_cost"),
         register_ref="burst_cost_name",
         sharded_builder=None,
+        bundleable=False,
     ),
     "serve/forward": ContractRow(
         name_file="serve/engine.py", name_attr="TRACE_PREFIX",
@@ -127,6 +148,9 @@ ENTRY_POINT_CONTRACTS: t.Dict[str, ContractRow] = {
         register_fn=("serve/engine.py", "PolicyEngine.warmup"),
         register_ref="_trace_names",
         sharded_builder=None,
+        # The single-device serve program is exactly what a fresh
+        # worker jit-dispatches — the bundle's raison d'être.
+        bundleable=True,
     ),
     "serve/sharded_forward": ContractRow(
         name_file="serve/sharded.py", name_attr="TRACE_PREFIX",
@@ -136,6 +160,10 @@ ENTRY_POINT_CONTRACTS: t.Dict[str, ContractRow] = {
         sharded_builder=(
             "serve/sharded.py", "ShardedPolicyEngine._build_forwards",
         ),
+        # Mesh-shaped: the executable is only valid for one concrete
+        # sub-mesh carving, so it is honestly NOT bundled — sharded
+        # workers ride the persistent cache.
+        bundleable=False,
     ),
 }
 
@@ -261,6 +289,48 @@ def _builder_uses_planners(ctx: FileContext, qualname: str) -> bool:
     return False
 
 
+def _check_bundle_manifest(project: Project) -> t.List[Finding]:
+    """stale-bundle-manifest: every ContractRow(...) literal in this
+    file must pass ``bundleable=`` as an explicit keyword with a bool
+    constant. The AOT manifest (aot/manifest.py) is derived from that
+    column at import time; a row relying on a positional slip or a
+    computed value would let an entry point ship without an auditable
+    bundleability decision."""
+    findings: t.List[Finding] = []
+    ctx = _find(project, "analysis/contracts.py")
+    if ctx is None:
+        return findings
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func
+        name = callee.attr if isinstance(callee, ast.Attribute) else (
+            callee.id if isinstance(callee, ast.Name) else None
+        )
+        if name != "ContractRow":
+            continue
+        kw = next(
+            (k for k in node.keywords if k.arg == "bundleable"), None
+        )
+        if kw is not None and (
+            isinstance(kw.value, ast.Constant)
+            and isinstance(kw.value.value, bool)
+        ):
+            continue
+        findings.append(Finding(
+            "stale-bundle-manifest", "analysis/contracts.py",
+            node.lineno, node.col_offset,
+            "ENTRY_POINT_CONTRACTS row without an explicit "
+            "`bundleable=True/False` literal — the AOT warm-start "
+            "manifest cannot tell whether this entry point is "
+            "pre-compiled into the bundle",
+            "add `bundleable=` to the ContractRow with a literal bool "
+            "(True only if aot/bundle.py serializes the program; see "
+            "docs/SERVING.md 'Cold start & warm-start bundles')",
+        ))
+    return findings
+
+
 def check(project: Project) -> t.List[Finding]:
     findings: t.List[Finding] = []
     if not any(
@@ -268,6 +338,7 @@ def check(project: Project) -> t.List[Finding]:
         for p in project.by_path
     ):
         return findings
+    findings.extend(_check_bundle_manifest(project))
     table_keys = set(ENTRY_POINT_CONTRACTS)
     entry_keys = set(ENTRY_POINTS)
     for missing in sorted(entry_keys - table_keys):

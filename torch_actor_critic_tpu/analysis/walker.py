@@ -71,6 +71,7 @@ RULE_FAMILIES: t.Dict[str, t.Tuple[str, ...]] = {
         "missing-cost-registration",
         "incoherent-sharding",
         "stale-contract",
+        "stale-bundle-manifest",
     ),
     "conventions": (
         "silent-exception-swallow",

@@ -1,0 +1,122 @@
+"""The AOT pre-compile manifest, derived from the checked tables.
+
+There is deliberately **no third list** of "programs to pre-compile":
+the set is derived from ``reachability.ENTRY_POINTS`` (which the
+static analyzer pins against the code, PR 7) joined with the
+``bundleable`` column of ``contracts.ENTRY_POINT_CONTRACTS`` (which the
+``stale-bundle-manifest`` lint rule requires to be an explicit literal
+on every row, PR 15). A new jit entry point therefore cannot ship
+without declaring whether it is AOT-bundled, and a bundleability claim
+cannot outlive the entry point it describes — :func:`entry_point_table`
+fails loudly on any divergence between the two tables.
+
+Bundled programs are the *serve* plane's bucket ladder: one program per
+``(bucket, deterministic)`` pair (exactly the jit-cache keys
+``PolicyEngine.warmup`` populates). Train-plane entry points are
+``bundleable=False`` — their shapes depend on run config rather than a
+fixed ladder, so they ride the shared persistent compilation cache
+(:mod:`~torch_actor_critic_tpu.aot.cache`) instead of serialized
+executables.
+"""
+
+from __future__ import annotations
+
+import typing as t
+
+from torch_actor_critic_tpu.analysis.contracts import (
+    ENTRY_POINT_CONTRACTS,
+)
+from torch_actor_critic_tpu.analysis.reachability import ENTRY_POINTS
+
+__all__ = [
+    "ManifestError",
+    "ProgramSpec",
+    "bundled_entry_points",
+    "entry_point_table",
+    "program_filename",
+    "program_name",
+    "serve_programs",
+]
+
+
+class ManifestError(RuntimeError):
+    """The checked tables disagree — the manifest cannot be derived."""
+
+
+class ProgramSpec(t.NamedTuple):
+    """One program the bundle serializes: the watchdog/cost identity
+    specialized to a concrete ``(bucket, deterministic)`` shape."""
+
+    name: str           # e.g. "serve/forward[b4].sampled"
+    identity: str       # ENTRY_POINTS key, e.g. "serve/forward"
+    bucket: int
+    deterministic: bool
+
+
+def entry_point_table() -> t.Dict[str, bool]:
+    """``{identity: bundleable}`` over every checked entry point.
+
+    Raises :class:`ManifestError` unless ``ENTRY_POINTS`` and
+    ``ENTRY_POINT_CONTRACTS`` cover exactly the same identities — the
+    same invariant the ``stale-contract`` lint enforces, re-checked
+    here at runtime because the bundle builder must not silently skip
+    an entry point the tables disagree about.
+    """
+    entry_keys = set(ENTRY_POINTS)
+    table_keys = set(ENTRY_POINT_CONTRACTS)
+    if entry_keys != table_keys:
+        missing = sorted(entry_keys - table_keys)
+        extra = sorted(table_keys - entry_keys)
+        raise ManifestError(
+            "ENTRY_POINTS and ENTRY_POINT_CONTRACTS diverge — "
+            f"missing contract rows: {missing}; rows with no entry "
+            f"point: {extra}. Fix analysis/contracts.py (the "
+            "stale-contract lint flags this too)."
+        )
+    return {
+        identity: bool(ENTRY_POINT_CONTRACTS[identity].bundleable)
+        for identity in sorted(entry_keys)
+    }
+
+
+def bundled_entry_points() -> t.Tuple[str, ...]:
+    """The identities whose programs go into the warm-start bundle."""
+    return tuple(
+        identity
+        for identity, bundleable in entry_point_table().items()
+        if bundleable
+    )
+
+
+def program_name(identity: str, bucket: int, deterministic: bool) -> str:
+    """The bundle-internal program key: the per-bucket watchdog label
+    (``serve/forward[b4]``) plus which half of the jit pair."""
+    mode = "det" if deterministic else "sampled"
+    return f"{identity}[b{int(bucket)}].{mode}"
+
+
+def program_filename(name: str) -> str:
+    """Filesystem-safe serialized-program file name for ``name``."""
+    safe = name.replace("/", "__").replace("[b", "-b").replace("]", "")
+    return f"{safe}.jexp"
+
+
+def serve_programs(
+    buckets: t.Sequence[int],
+    deterministic_only: bool = False,
+) -> t.List[ProgramSpec]:
+    """Every program a serve worker's warmup will dispatch for the
+    given bucket ladder: the bundled identities x buckets x
+    (deterministic, sampled) — the exact jit-cache keys
+    ``PolicyEngine.warmup`` populates, in warmup order."""
+    specs: t.List[ProgramSpec] = []
+    for identity in bundled_entry_points():
+        for bucket in buckets:
+            for det in (True,) if deterministic_only else (True, False):
+                specs.append(ProgramSpec(
+                    name=program_name(identity, bucket, det),
+                    identity=identity,
+                    bucket=int(bucket),
+                    deterministic=det,
+                ))
+    return specs

@@ -192,6 +192,14 @@ def actor_main(
         level=logging.INFO,
         format=f"[actor {actor_id}.{incarnation}] %(message)s",
     )
+    # Cold-start machinery (aot/cache.py): a learner running with
+    # --compile-cache publishes the dir via TAC_COMPILE_CACHE, which
+    # this spawn-child inherited — so a RESPAWNED actor (incarnation
+    # > 0) finds its acting programs already compiled on disk instead
+    # of re-paying the compile inside its restart window.
+    from torch_actor_critic_tpu.aot.cache import enable_cache_from_env
+
+    enable_cache_from_env()
     stop = threading.Event()
 
     def _stop_handler(signum, frame):  # pragma: no cover — signal path

@@ -45,9 +45,19 @@ logger = logging.getLogger(__name__)
 __all__ = ["RecompilationWatchdog", "get_watchdog"]
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# Persistent-compilation-cache counters (aot/cache.py, docs/
+# OBSERVABILITY.md "Cold start"): these fire as PLAIN monitoring
+# events, one per cache probe. Crucially, a cache HIT still fires
+# _COMPILE_EVENT (the retrieval runs through backend_compile), so
+# compile counts alone cannot tell a warm-start from a cold one —
+# the hit/miss pair is what distinguishes "loaded from the bundle's
+# cache" from "paid a real XLA compile".
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 _UNATTRIBUTED = "unattributed"
 _MAX_ANOMALIES = 100  # bounded memory; the counter keeps the true total
 _MAX_COMPILE_LOG = 256  # newest per-compile records kept for the trace
+_MAX_REJECT_REASONS = 20  # newest bundle-rejection reasons kept
 
 
 class _SourceCtx:
@@ -87,6 +97,28 @@ class _ExpectedCtx:
         return False
 
 
+class _BundleLoadCtx:
+    """Thread-local marker for warm-start bundle loading (aot/):
+    compiles in this extent are executables arriving from the bundle's
+    pre-populated compilation cache, NOT warmup work this process paid
+    for. They get their own counter — classifying them as ``expected``
+    (the warmup suppression) would make a broken bundle (every "load"
+    actually a full compile) indistinguishable from a working one."""
+
+    __slots__ = ("_wd",)
+
+    def __init__(self, wd: "RecompilationWatchdog"):
+        self._wd = wd
+
+    def __enter__(self):
+        self._wd._tls.bundle = getattr(self._wd._tls, "bundle", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        self._wd._tls.bundle -= 1
+        return False
+
+
 class RecompilationWatchdog:
     def __init__(self):
         self._lock = threading.Lock()
@@ -97,6 +129,30 @@ class RecompilationWatchdog:
         self.compile_time_s = 0.0  # guarded-by: _lock
         self.post_steady_total = 0  # guarded-by: _lock
         self.anomalies: t.List[dict] = []  # guarded-by: _lock
+        # Three-way compile classification (docs/OBSERVABILITY.md
+        # "Cold start & warm-start bundles"): every backend compile is
+        # exactly one of live (a dispatch paid it — must be 0 in
+        # steady state), warmup (inside expected(): deliberate
+        # pre-compilation), or bundle-load (inside bundle_load():
+        # served from a warm-start bundle's cache). Previously warmup
+        # and bundle loads would both have landed in `expected`,
+        # hiding a broken bundle behind the warmup suppression.
+        self.live_compiles = 0  # guarded-by: _lock
+        self.warmup_compiles = 0  # guarded-by: _lock
+        self.bundle_load_compiles = 0  # guarded-by: _lock
+        self._live_by_source: t.Dict[str, int] = {}  # guarded-by: _lock
+        # Warm-start bundle accounting (aot/bundle.py): programs
+        # successfully loaded from a bundle vs bundles rejected on a
+        # fingerprint/aval mismatch (rejection falls back to live
+        # compile — loudly, and counted here).
+        self.bundle_hits = 0  # guarded-by: _lock
+        self.bundle_rejected = 0  # guarded-by: _lock
+        self._bundle_reject_reasons: collections.deque = (  # guarded-by: _lock
+            collections.deque(maxlen=_MAX_REJECT_REASONS)
+        )
+        # Persistent compilation-cache probes (aot/cache.py).
+        self.cache_hits_total = 0  # guarded-by: _lock
+        self.cache_misses_total = 0  # guarded-by: _lock
         self._steady_prefixes: t.Set[str] = set()  # guarded-by: _lock
         # Bounded per-compile record ring (source, end wall time,
         # duration): the cross-plane trace export draws compile spans
@@ -117,6 +173,9 @@ class RecompilationWatchdog:
         import jax.monitoring
 
         jax.monitoring.register_event_duration_secs_listener(self._on_event)
+        # Plain-event listener for the persistent-cache hit/miss pair
+        # (no duration payload; see _CACHE_HIT_EVENT above).
+        jax.monitoring.register_event_listener(self._on_plain_event)
         return self
 
     # ------------------------------------------------------- attribution
@@ -134,6 +193,38 @@ class RecompilationWatchdog:
         registered after the serving plane went steady)."""
         return _ExpectedCtx(self)
 
+    def bundle_load(self) -> _BundleLoadCtx:
+        """Context manager marking compiles as warm-start bundle loads
+        (aot/): counted under ``bundle_load_compiles`` — a THIRD class
+        next to live and warmup, never a steady-state anomaly. Takes
+        precedence over :meth:`expected` when nested (a bundle-armed
+        warmup wraps both)."""
+        return _BundleLoadCtx(self)
+
+    # ---------------------------------------------------- bundle counters
+
+    def note_bundle_hit(self, n: int = 1) -> None:
+        """Count ``n`` programs successfully loaded from a warm-start
+        bundle (aot/bundle.py calls this once per program it serves
+        from the bundle's cache)."""
+        with self._lock:
+            self.bundle_hits += int(n)
+
+    def note_bundle_rejected(self, reason: str) -> None:
+        """Count one warm-start bundle rejection (fingerprint or aval
+        mismatch). The caller falls back to live compilation; the
+        rejection is logged loudly here and surfaced on /metrics."""
+        with self._lock:
+            self.bundle_rejected += 1
+            self._bundle_reject_reasons.append(str(reason)[:300])
+        logger.warning(
+            "warm-start bundle REJECTED (falling back to live "
+            "compile): %s — rebuild the bundle against this "
+            "environment (docs/SERVING.md 'Cold start & warm-start "
+            "bundles')",
+            reason,
+        )
+
     # ----------------------------------------------------- steady regime
 
     def mark_steady(self, prefix: str) -> None:
@@ -150,23 +241,43 @@ class RecompilationWatchdog:
 
     # ----------------------------------------------------------- listener
 
+    def _on_plain_event(self, name: str, **kw) -> None:
+        if name == _CACHE_HIT_EVENT:
+            with self._lock:
+                self.cache_hits_total += 1
+        elif name == _CACHE_MISS_EVENT:
+            with self._lock:
+                self.cache_misses_total += 1
+
     def _on_event(self, name: str, secs: float, **kw) -> None:
         if name != _COMPILE_EVENT:
             return
         stack = getattr(self._tls, "stack", None)
         src = stack[-1] if stack else _UNATTRIBUTED
-        expected = getattr(self._tls, "expected", 0) > 0
+        bundle = getattr(self._tls, "bundle", 0) > 0
+        expected = not bundle and getattr(self._tls, "expected", 0) > 0
+        kind = "bundle" if bundle else ("warmup" if expected else "live")
         with self._lock:
             self.compiles_total += 1
             self.by_source[src] = self.by_source.get(src, 0) + 1
             self.compile_time_s += secs
+            if bundle:
+                self.bundle_load_compiles += 1
+            elif expected:
+                self.warmup_compiles += 1
+            else:
+                self.live_compiles += 1
+                self._live_by_source[src] = (
+                    self._live_by_source.get(src, 0) + 1
+                )
             self._compile_log.append({
                 "source": src,
                 "time": time.time(),  # the event fires at compile END
                 "duration_s": round(secs, 4),
                 "expected": expected,
+                "kind": kind,
             })
-            steady = not expected and any(
+            steady = not (expected or bundle) and any(
                 src.startswith(p) for p in self._steady_prefixes
             )
             if not steady:
@@ -208,7 +319,50 @@ class RecompilationWatchdog:
                 "by_source": dict(self.by_source),
                 "post_steady_compiles": self.post_steady_total,
                 "anomalies": list(self.anomalies),
+                # Cold-start accounting (aot/, docs/OBSERVABILITY.md):
+                # live / warmup / bundle-load are DISJOINT classes of
+                # compiles_total; hits/misses count persistent-cache
+                # probes; bundle_* count warm-start bundle outcomes.
+                "live_compiles": self.live_compiles,
+                "warmup_compiles": self.warmup_compiles,
+                "bundle_load_compiles": self.bundle_load_compiles,
+                "live_by_source": dict(self._live_by_source),
+                "bundle_hits": self.bundle_hits,
+                "bundle_rejected": self.bundle_rejected,
+                "bundle_reject_reasons": list(self._bundle_reject_reasons),
+                "cache_hits_total": self.cache_hits_total,
+                "cache_misses_total": self.cache_misses_total,
             }
+
+    def live_compiles_for(self, prefix: str = "") -> int:
+        """Live (neither warmup nor bundle-load) compiles attributed to
+        sources starting with ``prefix`` ("" = every source)."""
+        with self._lock:
+            return sum(
+                n for src, n in self._live_by_source.items()
+                if src.startswith(prefix)
+            )
+
+    def assert_zero_live(self, prefix: str = "") -> None:
+        """The steady-state cold-start assertion (aot/): raise if any
+        live compile has been attributed to sources under ``prefix``.
+        A warm-started worker must answer every request from warmup or
+        bundle-loaded executables — the coldstart smoke and the serve
+        plane's health checks call this after a flood."""
+        live = self.live_compiles_for(prefix)
+        if live:
+            with self._lock:
+                offenders = {
+                    src: n for src, n in self._live_by_source.items()
+                    if src.startswith(prefix)
+                }
+            raise AssertionError(
+                f"live_compiles == 0 violated: {live} live compile(s) "
+                f"under prefix {prefix!r} ({offenders}) — a request "
+                "paid an XLA compile that warmup or the warm-start "
+                "bundle should have covered (docs/SERVING.md 'Cold "
+                "start & warm-start bundles')"
+            )
 
     def reset(self) -> None:
         """Zero all counts and steady regimes (test isolation; the
@@ -221,6 +375,15 @@ class RecompilationWatchdog:
             self.anomalies = []
             self._steady_prefixes = set()
             self._compile_log.clear()
+            self.live_compiles = 0
+            self.warmup_compiles = 0
+            self.bundle_load_compiles = 0
+            self._live_by_source = {}
+            self.bundle_hits = 0
+            self.bundle_rejected = 0
+            self._bundle_reject_reasons.clear()
+            self.cache_hits_total = 0
+            self.cache_misses_total = 0
 
 
 _WATCHDOG: RecompilationWatchdog | None = None

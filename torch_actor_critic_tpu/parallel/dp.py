@@ -506,12 +506,19 @@ class DataParallelSAC:
         """Push per-device chunks and run ``num_updates`` DP gradient
         steps as one device dispatch. ``chunk`` leaves have leading axes
         ``(n_dev, per_dev, ...)`` (see :func:`shard_chunk`)."""
+        from torch_actor_critic_tpu.aot.cache import cache_excluded
+
         if self._burst is None or self._burst[0] != num_updates:
             self._burst = (
                 num_updates,
                 self._build_burst(num_updates, state, buffer, chunk),
             )
-        return self._burst[1](state, buffer, chunk)
+        # cache_excluded: the donated burst/push executable pair is
+        # unsafe to DESERIALIZE from the persistent compilation cache
+        # (jaxlib 0.4.36 XLA:CPU memory corruption — see aot/cache.py);
+        # these programs always compile live.
+        with cache_excluded():
+            return self._burst[1](state, buffer, chunk)
 
     def burst_jit(self, num_updates: int):
         """The cached jitted burst for ``num_updates`` (None before its
@@ -543,7 +550,11 @@ class DataParallelSAC:
                 out_shardings=buf_sh,
                 donate_argnums=(0,),
             )
-        return self._push(buffer, chunk)
+        from torch_actor_critic_tpu.aot.cache import cache_excluded
+
+        # Same persistent-cache exclusion as update_burst (aot/cache.py).
+        with cache_excluded():
+            return self._push(buffer, chunk)
 
     # ------------------------------------------------------------- acting
 

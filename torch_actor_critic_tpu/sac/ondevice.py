@@ -359,10 +359,15 @@ class OnDeviceLoop:
         ``sac/algorithm.py:227-228,273``)."""
         from torch_actor_critic_tpu.diagnostics.watchdog import get_watchdog
 
+        from torch_actor_critic_tpu.aot.cache import cache_excluded
+
         sig = (steps, update_every, warmup)
         if sig not in self._epoch_fns:
             self._epoch_fns[sig] = self._build_epoch(*sig)
-        with get_watchdog().source(self.epoch_cost_name):
+        # cache_excluded: the donated epoch executable is unsafe to
+        # deserialize from the persistent compilation cache (see
+        # aot/cache.py) — always compile live.
+        with get_watchdog().source(self.epoch_cost_name), cache_excluded():
             return self._epoch_fns[sig](
                 train_state, buffer, env_states, act_key
             )
@@ -647,10 +652,14 @@ class PopulationOnDeviceLoop:
         device dispatch for everything."""
         from torch_actor_critic_tpu.diagnostics.watchdog import get_watchdog
 
+        from torch_actor_critic_tpu.aot.cache import cache_excluded
+
         sig = (steps, update_every, warmup)
         if sig not in self._epoch_fns:
             self._epoch_fns[sig] = self._build_epoch(*sig)
-        with get_watchdog().source(self.epoch_cost_name):
+        # Same persistent-cache exclusion as the base epoch dispatch
+        # (aot/cache.py).
+        with get_watchdog().source(self.epoch_cost_name), cache_excluded():
             return self._epoch_fns[sig](state, buffer, env_states, act_keys)
 
     def epoch_jit(self, steps: int, update_every: int, warmup: bool = False):

@@ -468,6 +468,10 @@ class Trainer:
             self.td_hist = None
             self.watchdog = None
         self._diag_rows: t.List[dict] = []
+        # --emit-bundle (aot/, docs/SERVING.md "Cold start"): one-shot
+        # latch, independent of the diagnostics tier (the watchdog's
+        # _first_update_epoch only exists with diagnostics on).
+        self._bundle_emitted = not self.config.emit_bundle
 
         # One env per dp mesh slice, stepped as a pool: sequential
         # in-process by default, parallel worker processes over the
@@ -949,6 +953,52 @@ class Trainer:
             wait=wait,
             arrays=self._checkpoint_arrays(),
         )
+
+    def _emit_warm_start_bundle(self, epoch: int) -> None:
+        """``--emit-bundle``: build the serve-plane warm-start bundle
+        next to the Orbax checkpoint (aot/bundle.py) at the first
+        update epoch — the earliest moment real actor params exist.
+        One-shot and non-fatal: a failed build is logged and never
+        retried (training must not pay the build every epoch), and the
+        checkpoint itself is untouched either way."""
+        self._bundle_emitted = True
+        if self.checkpointer is None or not is_coordinator():
+            return
+        from torch_actor_critic_tpu.aot.bundle import (
+            default_bundle_dir,
+            emit_bundle,
+        )
+
+        try:
+            params = jax.device_get(self.serve_actor_params())
+            bundle = emit_bundle(
+                self.checkpointer.directory,
+                self.sac.actor_def,
+                self.pool.obs_spec,
+                params,
+                max_batch=self.config.bundle_max_batch,
+            )
+            logger.info(
+                "epoch %d: warm-start bundle emitted at %s "
+                "(%d programs, %d cache entries) — serve.py "
+                "--warm-start auto boots compile-free",
+                epoch, bundle.root, len(bundle.programs()),
+                bundle.manifest.get("cache_entries", 0),
+            )
+        except Exception:  # noqa: BLE001 — the bundle is an artifact,
+            # not training state; a failed build costs the next serve
+            # worker its cold start, never the run
+            logger.exception(
+                "epoch %d: warm-start bundle emission at %s failed; "
+                "training continues (serve workers will live-compile)",
+                epoch, default_bundle_dir(self.checkpointer.directory),
+            )
+
+    def serve_actor_params(self):
+        """The actor-param subtree a serve worker would restore from a
+        checkpoint of the current state — what the warm-start bundle
+        must be built against for its avals to match at load time."""
+        return self.state.actor_params
 
     def _load_checkpoint(
         self, epoch: int | None = None, include_buffer: bool = True
@@ -1458,6 +1508,17 @@ class Trainer:
             if self.watchdog is not None:
                 wd_snap = self.watchdog.snapshot()
                 last_metrics["xla_compiles"] = wd_snap["compiles_total"]
+                # Cold-start accounting (aot/, docs/SERVING.md): the
+                # live/warmup/bundle-load compile split plus the
+                # persistent-cache hit/miss counters, onto
+                # metrics.jsonl next to the compile total they explain.
+                last_metrics["xla_live_compiles"] = wd_snap["live_compiles"]
+                last_metrics["xla_cache_hits"] = wd_snap["cache_hits_total"]
+                last_metrics["xla_cache_misses"] = (
+                    wd_snap["cache_misses_total"]
+                )
+                last_metrics["bundle_hits"] = wd_snap["bundle_hits"]
+                last_metrics["bundle_rejected"] = wd_snap["bundle_rejected"]
                 new_anoms = wd_snap["anomalies"][self._wd_anomalies_seen:]
                 self._wd_anomalies_seen = len(wd_snap["anomalies"])
                 if rec is not None:
@@ -1548,6 +1609,13 @@ class Trainer:
             self._epoch_boundary_hook(
                 e, sentinel_ok, saved_this_epoch, last_metrics, rec
             )
+
+            # --emit-bundle: first epoch with real updates (losses_q
+            # non-empty — NOT the watchdog's first-update latch, which
+            # only exists with diagnostics on) builds the serve-plane
+            # warm-start bundle next to the checkpoint.
+            if not self._bundle_emitted and losses_q:
+                self._emit_warm_start_bundle(e)
 
             # Logged after the save so sentinel_s/save_s land in the
             # epoch that paid them.

@@ -131,19 +131,23 @@ class PolicyEngine:
             b: f"{self.TRACE_PREFIX}[b{b}]" for b in self.buckets
         }
         # Compile accounting (docs/OBSERVABILITY.md recompile
-        # watchdog): per-bucket warmup vs LIVE compile counts — a
-        # silently-recompiling bucket was previously indistinguishable
-        # from a slow one. First-seen (bucket, deterministic) keys
-        # count here; the process-wide watchdog additionally attributes
-        # every real backend compile (including re-compiles of
-        # already-seen keys) to this engine's `serve/forward[bN]`
+        # watchdog): per-bucket warmup vs LIVE vs bundle-load compile
+        # counts — a silently-recompiling bucket was previously
+        # indistinguishable from a slow one, and a bundle-loaded
+        # executable must not masquerade as either (the cost is a disk
+        # read, not an XLA run). First-seen (bucket, deterministic)
+        # keys count here; the process-wide watchdog additionally
+        # attributes every real backend compile (including re-compiles
+        # of already-seen keys) to this engine's `serve/forward[bN]`
         # source labels and flags post-steady ones as anomalies.
         self._compile_counts: t.Dict[int, t.List[int]] = (  # guarded-by: _lock
             {}
-        )  # bucket -> [warmup, live]
+        )  # bucket -> [warmup, live, bundle]
         self.compiles_total = 0  # guarded-by: _lock
         self._warmup_active = False  # guarded-by: _lock
+        self._bundle_active = False  # guarded-by: _lock
         self._warmed = False  # guarded-by: _lock
+        self.bundle_loaded = False  # guarded-by: _lock
         self._watchdog = get_watchdog().install()
 
     def _build_forwards(self) -> None:
@@ -256,19 +260,24 @@ class PolicyEngine:
         return frozenset(self._compiled)
 
     def compile_stats(self) -> dict:
-        """Per-bucket warmup/live compile counts for ``/metrics``:
-        ``live`` must stay 0 in a healthy service — every compile
-        belongs in warmup, and a nonzero live count means a real
-        request paid a multi-second compile (the recompilation
-        watchdog logs the offending bucket as it happens)."""
+        """Per-bucket warmup/live/bundle compile counts for
+        ``/metrics``: ``live`` must stay 0 in a healthy service — every
+        compile belongs in warmup (or came from the warm-start bundle
+        at disk-read cost), and a nonzero live count means a real
+        request paid a multi-second compile (the recompilation watchdog
+        logs the offending bucket as it happens)."""
         with self._lock:
             return {
                 "compiles_total": self.compiles_total,
                 "live_compiles": sum(
                     c[1] for c in self._compile_counts.values()
                 ),
+                "bundle_compiles": sum(
+                    c[2] for c in self._compile_counts.values()
+                ),
+                "bundle_loaded": self.bundle_loaded,
                 "buckets": {
-                    str(b): {"warmup": c[0], "live": c[1]}
+                    str(b): {"warmup": c[0], "live": c[1], "bundle": c[2]}
                     for b, c in sorted(self._compile_counts.items())
                 },
             }
@@ -322,9 +331,11 @@ class PolicyEngine:
             key_ = (bucket, bool(deterministic))
             if key_ not in self._compiled:
                 self._compiled.add(key_)
-                counts = self._compile_counts.setdefault(bucket, [0, 0])
-                live = not self._warmup_active
-                counts[1 if live else 0] += 1
+                counts = self._compile_counts.setdefault(bucket, [0, 0, 0])
+                live = not (self._warmup_active or self._bundle_active)
+                counts[
+                    2 if self._bundle_active else (1 if live else 0)
+                ] += 1
                 self.compiles_total += 1
                 if live and self._warmed:
                     logger.warning(
@@ -340,28 +351,78 @@ class PolicyEngine:
 
     # ------------------------------------------------------------ warmup
 
+    def _verify_bundle(
+        self,
+        bundle,
+        params,
+        deterministic_only: bool,
+        buckets: t.Sequence[int] | None,
+    ) -> None:
+        """Before a bundle-armed warmup dispatches anything: every
+        program this warmup will compile must exist in the bundle with
+        input avals matching the exact arguments the jit will see.
+        Raises ``aot.BundleMismatchError`` (loud rejection; the caller
+        counts it and falls back to a plain warmup). Deserializing each
+        program here also proves the serialized artifact round-trips —
+        a corrupt bundle is a rejection, not a crash mid-warmup."""
+        from torch_actor_critic_tpu.aot.manifest import program_name
+
+        # The sampled artifacts take RAW key data (bundle.py: typed-key
+        # avals don't serialize) — verify against that convention.
+        key_data = jax.random.key_data(jax.random.key(0))
+        for bucket in (buckets or self.buckets):
+            zero_obs = jax.tree_util.tree_map(
+                lambda s: np.zeros((bucket,) + tuple(s.shape), s.dtype),
+                self.obs_spec,
+            )
+            for det in (True,) if deterministic_only else (True, False):
+                name = program_name(self.TRACE_PREFIX, bucket, det)
+                call_args = (
+                    (params, zero_obs) if det
+                    else (params, zero_obs, key_data)
+                )
+                bundle.verify_program(name, *call_args)
+
     def warmup(
         self,
         params,
         deterministic_only: bool = False,
         buckets: t.Sequence[int] | None = None,
+        bundle=None,
     ) -> t.List[t.Tuple[int, bool]]:
         """Trace + compile every ``(bucket, deterministic)`` program up
         front so no live request ever pays a compile. Returns the list
         of shapes warmed. Compiles in here count as ``warmup`` in
         :meth:`compile_stats` and are ``expected`` to the recompilation
         watchdog (a slot registered after the serving plane went steady
-        must not flag its own warmup as anomalies)."""
+        must not flag its own warmup as anomalies).
+
+        With ``bundle`` (a verified-compatible
+        :class:`~torch_actor_critic_tpu.aot.WarmStartBundle`), the
+        programs are first checked against the bundle's serialized
+        avals — a mismatch raises ``BundleMismatchError`` before any
+        dispatch — and the warmup dispatches then run under the
+        watchdog's ``bundle_load()`` scope: with the persistent cache
+        pointed at the bundle's ``xla_cache/`` they are disk reads, and
+        they count in the third (``bundle``) column of
+        :meth:`compile_stats`, not as warmup or live compiles."""
         from torch_actor_critic_tpu.telemetry.costmodel import (
             get_cost_registry,
         )
 
+        if bundle is not None:
+            self._verify_bundle(bundle, params, deterministic_only, buckets)
         warmed = []
         key = jax.random.key(0)
         with self._lock:
             self._warmup_active = True
+            self._bundle_active = bundle is not None
         try:
-            with self._watchdog.expected():
+            scope = (
+                self._watchdog.bundle_load() if bundle is not None
+                else self._watchdog.expected()
+            )
+            with scope:
                 for bucket in (buckets or self.buckets):
                     zero_obs = jax.tree_util.tree_map(
                         lambda s: np.zeros(
@@ -404,5 +465,10 @@ class PolicyEngine:
         finally:
             with self._lock:
                 self._warmup_active = False
+                self._bundle_active = False
                 self._warmed = True
+                if bundle is not None:
+                    self.bundle_loaded = True
+        if bundle is not None:
+            self._watchdog.note_bundle_hit(len(warmed))
         return warmed

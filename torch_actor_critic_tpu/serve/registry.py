@@ -126,6 +126,7 @@ class ModelRegistry:
         warmup: bool = True,
         replace: bool = False,
         breaker: CircuitBreaker | None = None,
+        bundle=None,
     ) -> dict:
         """Create a slot. ``params`` seeds it directly (tests/bench);
         ``ckpt_dir`` loads the latest epoch from an Orbax dir and arms
@@ -140,7 +141,16 @@ class ModelRegistry:
         slot's engine/checkpointer and restart its generation counter
         at 0, which clients tracking generations would see as the
         counter going backwards. With ``replace=True`` the displaced
-        slot's checkpointer is closed and the replacement is logged."""
+        slot's checkpointer is closed and the replacement is logged.
+
+        ``bundle`` (a :class:`~torch_actor_critic_tpu.aot
+        .WarmStartBundle`) arms the warmup with pre-compiled
+        executables: the slot's programs load from the bundle's
+        persistent cache (``bundle`` column of compile_stats) instead
+        of compiling live. A mismatched bundle is REJECTED loudly —
+        counted on the watchdog (``bundle_rejected``) — and the slot
+        falls back to a plain compile-from-scratch warmup; a stale
+        bundle can cost the cold start back, never a slot."""
         if (params is None) == (ckpt_dir is None):
             raise ValueError("pass exactly one of params / ckpt_dir")
         with self._lock:
@@ -189,7 +199,21 @@ class ModelRegistry:
 
         breaker.on_event = _hook
         if warmup:
-            engine.warmup(params)
+            if bundle is not None:
+                from torch_actor_critic_tpu.aot import BundleMismatchError
+                from torch_actor_critic_tpu.diagnostics.watchdog import (
+                    get_watchdog,
+                )
+
+                try:
+                    engine.warmup(params, bundle=bundle)
+                except BundleMismatchError as e:
+                    get_watchdog().note_bundle_rejected(
+                        f"slot {name!r}: {e.reason}"
+                    )
+                    engine.warmup(params)
+            else:
+                engine.warmup(params)
         slot = _Slot(engine, params, epoch, checkpointer, breaker)
         with self._lock:
             displaced = self._slots.get(name)
@@ -270,6 +294,7 @@ class ModelRegistry:
                 ),
                 "breaker": slot.breaker.state,
                 "reload_rejected_total": rejected,
+                "bundle_loaded": slot.engine.bundle_loaded,
             }
         return out
 
@@ -285,6 +310,9 @@ class ModelRegistry:
         return {
             "compiles_total": sum(s["compiles_total"] for s in slots.values()),
             "live_compiles": sum(s["live_compiles"] for s in slots.values()),
+            "bundle_compiles": sum(
+                s.get("bundle_compiles", 0) for s in slots.values()
+            ),
             "slots": slots,
         }
 

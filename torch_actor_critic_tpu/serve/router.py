@@ -255,6 +255,23 @@ class FleetRouter:
         for w in list(self.workers.values()):
             self._poll_worker(w)
 
+    def add_worker(self, url: str) -> str:
+        """Admit a worker at runtime (warm-pool scale-up / dead-worker
+        replacement, docs/SERVING.md "Cold start"): registers the URL
+        under the next free ``wN`` name and health-polls it once so an
+        already-warm worker enters rotation immediately. Returns the
+        assigned name."""
+        with self._lock:
+            idx = 0
+            while f"w{idx}" in self.workers:
+                idx += 1
+            name = f"w{idx}"
+            w = WorkerState(name, url)
+            self.workers[name] = w
+        logger.info("router: worker %s added at %s", name, url)
+        self._poll_worker(w)
+        return name
+
     def membership(self) -> dict:
         with self._lock:
             views = {n: w.view() for n, w in self.workers.items()}

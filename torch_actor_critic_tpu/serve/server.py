@@ -463,6 +463,7 @@ class PolicyServer:
                     comp = server.registry.compile_stats()
                     snap["compiles_total"] = comp["compiles_total"]
                     snap["live_compiles"] = comp["live_compiles"]
+                    snap["bundle_compiles"] = comp.get("bundle_compiles", 0)
                     snap["compiles"] = comp["slots"]
                     snap["xla"] = _watchdog().snapshot()
                     # Overload containment state: admission bound and

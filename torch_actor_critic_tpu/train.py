@@ -197,6 +197,16 @@ def main(argv=None):
             }
         )
 
+    if config.compile_cache:
+        # Persistent compilation cache (aot/cache.py, docs/SERVING.md
+        # "Cold start"): epoch programs persist to disk, so a
+        # preempted learner's `--run <id>` restart — and every spawned
+        # actor process, which joins via the exported TAC_COMPILE_CACHE
+        # env var — resumes compile-free.
+        from torch_actor_critic_tpu.aot import enable_persistent_cache
+
+        enable_persistent_cache(config.compile_cache)
+
     mesh = make_mesh(dp=args.devices, fsdp=args.fsdp)
     checkpointer = Checkpointer(
         tracker.artifact_path("checkpoints"), save_buffer=args.save_buffer

@@ -381,6 +381,25 @@ class SACConfig:
     # the metric stream is bitwise identical (pinned by
     # tests/test_sanitize.py).
     sanitize: str = "off"
+    # Cold-start machinery (aot/, docs/SERVING.md "Cold start &
+    # warm-start bundles"): `compile_cache` points the persistent XLA
+    # compilation cache at a directory shared by fleet workers,
+    # spawned actors, and learner RESTARTS — a preempted learner
+    # resumes compile-free because its epoch programs are already on
+    # disk. The dir is published to child processes via
+    # TAC_COMPILE_CACHE. Empty (default) leaves jax's cache config
+    # untouched.
+    compile_cache: str = ""
+    # `--emit-bundle` writes a warm-start bundle next to the Orbax
+    # checkpoint at the FIRST update epoch (the earliest moment real
+    # actor params exist): serve.py --warm-start auto then answers its
+    # first /act with zero live compiles. Requires checkpointing
+    # (save_every > 0) for the checkpoint-adjacent location.
+    emit_bundle: bool = False
+    # Serve bucket ladder ceiling the emitted bundle pre-compiles for
+    # (must match the serve worker's --max-batch for the bundle to
+    # cover its buckets; smokes shrink it to keep the build cheap).
+    bundle_max_batch: int = 64
 
     def __post_init__(self):
         if not (len(self.filters) == len(self.kernel_sizes) == len(self.strides)):
