@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke shard-serve-smoke decouple-smoke visual-smoke scenario-smoke sanitize-smoke replay-smoke coldstart-smoke
+.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke shard-serve-smoke decouple-smoke visual-smoke scenario-smoke sanitize-smoke replay-smoke coldstart-smoke obs-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -187,6 +187,16 @@ replay-smoke:
 # bundles").
 coldstart-smoke:
 	JAX_PLATFORMS=cpu python scripts/coldstart_smoke.py
+
+# Run-wide observability smoke (CPU, real CLI): a serving fleet
+# (serve.py --fleet 2) plus an actor-fleet learner (--actors 2 --obs)
+# whose ObsCollector aggregates three planes with zero scrape
+# failures; an injected serving-goodput outage drives the SLO engine
+# through exactly one breach + one recovery; and the exported Perfetto
+# timeline stitches one staging span id across actor, transport, and
+# learner process lanes (docs/OBSERVABILITY.md "Run-wide plane").
+obs-smoke:
+	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

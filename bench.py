@@ -13,7 +13,8 @@ Extra keys: backend, device_kind, mfu, flops_per_step, sweep (batch/
 width MFU scaling), visual (CNN burst at the wall-runner geometry),
 on_device (fused env+update loop throughput), host_envs (worker-pool
 on/off incl. the wall-runner crossover), telemetry_overhead (Trainer
-throughput with telemetry off vs on), diagnostics_overhead (tiered
+throughput with telemetry off vs on), obs_overhead (run-wide obs
+collector + SLO engine off vs on), diagnostics_overhead (tiered
 off/light/full learning-health diagnostics cost), and — on any failure —
 "error"/"diagnostics" instead of a silent traceback. Real-chip runs
 snapshot themselves into ``runs/tpu/`` and a CPU-fallback run merges
@@ -2041,6 +2042,70 @@ def bench_telemetry_overhead(budget_s=420.0):
     return out
 
 
+def bench_obs_overhead(budget_s=420.0):
+    """Run-wide observability cost (docs/OBSERVABILITY.md "Run-wide
+    plane"): steady-state Trainer throughput with the obs collector
+    off vs on (scrape thread + learner source + SLO engine + obs.jsonl
+    sink + per-epoch obs/ metric columns) at a tiny CPU config. Same
+    ABBA discipline and 5% acceptance bar as telemetry_overhead — the
+    collector lives on its own thread, so steady-state cost should be
+    the learner-source snapshot plus a dict merge per epoch."""
+    import tempfile
+
+    from torch_actor_critic_tpu.parallel import make_mesh
+    from torch_actor_critic_tpu.sac.trainer import Trainer
+    from torch_actor_critic_tpu.utils.config import SACConfig
+    from torch_actor_critic_tpu.utils.tracking import Tracker
+
+    t_start = time.time()
+    out = {}
+    tiny = dict(
+        hidden_sizes=(32, 32), batch_size=32, epochs=4,
+        steps_per_epoch=400, start_steps=50, update_after=50,
+        update_every=50, buffer_size=5000, max_ep_len=200,
+    )
+    # ABBA order for the same reason as telemetry_overhead: slow drift
+    # biases off-then-on; interleaving cancels it to first order.
+    rates: dict = {"off": [], "grad_off": [], "on": [], "grad_on": []}
+    for mode in ("off", "on", "on", "off"):
+        if time.time() - t_start > budget_s:
+            break
+        try:
+            root = tempfile.mkdtemp(prefix="bench_obs_")
+            tracker = Tracker(experiment="bench", root=root)
+            tr = Trainer(
+                "Pendulum-v1",
+                SACConfig(**tiny, obs=(mode == "on"), obs_interval_s=0.5),
+                mesh=make_mesh(dp=1), tracker=tracker,
+            )
+            try:
+                tr.train()
+            finally:
+                tr.close()
+            rows = tracker.metrics()[1:]
+            rates[mode].extend(r["env_steps_per_sec"] for r in rows)
+            rates[f"grad_{mode}"].extend(
+                r["grad_steps_per_sec"] for r in rows
+            )
+        except Exception as e:  # noqa: BLE001 — per-run best effort
+            out.setdefault("errors", []).append(repr(e)[:200])
+    # Max-of-post-warmup-epochs per mode (least-contended estimate),
+    # matching telemetry_overhead's accounting.
+    for mode in ("off", "on"):
+        if rates[mode]:
+            out[mode] = {
+                "env_steps_per_sec": round(max(rates[mode]), 1),
+                "grad_steps_per_sec": round(max(rates[f"grad_{mode}"]), 1),
+                "epoch_rates": [round(r, 1) for r in rates[mode]],
+            }
+    off = out.get("off", {}).get("env_steps_per_sec")
+    on = out.get("on", {}).get("env_steps_per_sec")
+    if off and on:
+        out["overhead_pct"] = round((off - on) / off * 100, 2)
+    log(f"obs overhead: {out}")
+    return out
+
+
 def bench_replay(budget_s=300.0):
     """Tiered-replay throughput (docs/REPLAY.md): the host-side costs
     the tier stack adds around the (unchanged) device ring — waterfall
@@ -2793,6 +2858,7 @@ _STAGES = {
     "telemetry_overhead": lambda: {
         "telemetry_overhead": bench_telemetry_overhead()
     },
+    "obs_overhead": lambda: {"obs_overhead": bench_obs_overhead()},
     "diagnostics_overhead": lambda: {
         "diagnostics_overhead": bench_diagnostics_overhead()
     },
@@ -3197,6 +3263,16 @@ def main():
     )
     if res and "error" in res:
         diagnostics.append({"telemetry_stage_error": res.pop("error")})
+    if res:
+        out.update(res)
+
+    # 5c'. Run-wide observability overhead (obs/ collector + SLO
+    # engine off vs on, same ABBA + 5% bar) — host-side like 5c.
+    res = run_stage_subprocess(
+        "obs_overhead", 600, diagnostics, platform="cpu"
+    )
+    if res and "error" in res:
+        diagnostics.append({"obs_stage_error": res.pop("error")})
     if res:
         out.update(res)
 

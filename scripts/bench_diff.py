@@ -38,11 +38,17 @@ HIGHER_BETTER = re.compile(
     # Cold-start stage (bench_coldstart): bundle speedup and the
     # persistent-compile-cache hit rate; its *_ms keys (ready_ms /
     # first_act_ms) already ride the lower-better _ms$ direction.
-    r"|hbm_util$|_speedup$|hit_rate$)"
+    r"|hbm_util$|_speedup$|hit_rate$"
+    # Run-wide obs plane (obs/; bench_obs_overhead + the obs/ metric
+    # columns): live scrape sources are goodput for the collector.
+    r"|sources_live$)"
 )
 LOWER_BETTER = re.compile(
     r"(^p50_ms$|^p95_ms$|^p99_ms$|^mean_ms$|^max_ms$|_ms$"
-    r"|^ms_per_lockstep_round$|overhead.*_pct$)"
+    r"|^ms_per_lockstep_round$|overhead.*_pct$"
+    # Obs plane: failed scrapes and SLO breaches regress the run even
+    # when throughput holds (the collector itself must stay healthy).
+    r"|_failed_total$|breaches_total$)"
 )
 
 

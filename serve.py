@@ -128,6 +128,24 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                           "router; a dead worker is replaced by "
                           "drawing a spare instead of paying "
                           "spawn+compile (aot/prefork.py)")
+    flt.add_argument("--obs", action="store_true",
+                     help="Run-wide observability plane (obs/): an "
+                          "ObsCollector thread scrapes the router and "
+                          "every worker's /metrics on a fixed "
+                          "interval, merges them, evaluates SLO "
+                          "rules, and serves the merged view on its "
+                          "own /metrics endpoint "
+                          "(docs/OBSERVABILITY.md 'Run-wide plane')")
+    flt.add_argument("--obs-port", type=int, default=0,
+                     help="Port for the obs collector's own HTTP "
+                          "endpoint (0 = ephemeral; printed in the "
+                          "fleet startup JSON)")
+    flt.add_argument("--obs-interval", type=float, default=2.0,
+                     help="Obs collector scrape interval seconds")
+    flt.add_argument("--slo-config", metavar="PATH", default=None,
+                     help="JSON list of SLO rules for the obs "
+                          "collector (obs/slo.py grammar; default: "
+                          "built-in rule set)")
     srv.add_argument("--buckets", type=str, default=None,
                      help="Comma-separated bucket sizes (default: powers "
                           "of two up to max-batch)")
@@ -270,17 +288,21 @@ def _worker_argv(argv, worker: int | None = None):
     import sys
 
     src = list(sys.argv[1:] if argv is None else argv)
+    take_value = (
+        "--fleet", "--port", "--router-poll", "--warm-pool",
+        "--obs-port", "--obs-interval", "--slo-config",
+    )
     out, skip = [], False
     for a in src:
         if skip:
             skip = False
             continue
-        if a in ("--fleet", "--port", "--router-poll", "--warm-pool"):
+        if a in take_value:
             skip = True
             continue
-        if a.split("=", 1)[0] in (
-            "--fleet", "--port", "--router-poll", "--warm-pool"
-        ):
+        if a == "--obs":
+            continue
+        if a.split("=", 1)[0] in take_value:
             continue
         out.append(a)
     if worker is not None:
@@ -389,6 +411,30 @@ def run_fleet(args, argv):
     )
     router.poll_once()
 
+    # Run-wide observability plane (docs/OBSERVABILITY.md): one
+    # collector thread scrapes the router's aggregated /metrics plus
+    # every worker's own /metrics, merges them, and evaluates SLO
+    # rules.  A worker dying mid-scrape is a counted scrape failure,
+    # never a collector crash.
+    obs = None
+    if args.obs:
+        from torch_actor_critic_tpu.obs import (
+            ObsCollector,
+            http_source,
+            load_rules,
+        )
+
+        obs = ObsCollector(
+            interval_s=args.obs_interval,
+            port=args.obs_port,
+            rules=load_rules(args.slo_config) if args.slo_config else None,
+        )
+        obs.add_source("router", http_source(router.address))
+        for i, addr in enumerate(addresses):
+            obs.add_source(f"w{i}", http_source(addr))
+        obs.start()
+        logger.info("obs collector serving on %s", obs.address)
+
     # Pre-forked warm spares (aot/prefork.py): each spare is a fully
     # booted, warmed worker waiting off-rotation; the monitor below
     # draws one the moment a live worker dies.
@@ -435,6 +481,8 @@ def run_fleet(args, argv):
                     with worker_lock:
                         workers.append(drawn.handle)
                     name = router.add_worker(drawn.address)
+                    if obs is not None:
+                        obs.add_source(name, http_source(drawn.address))
                     logger.info(
                         "worker pid %d died; warm spare admitted as %s "
                         "at %s (pool: %s)",
@@ -473,11 +521,16 @@ def run_fleet(args, argv):
         )),
         "pids": pids,
         "warm_pool": pool.stats() if pool is not None else None,
+        "obs": obs.address if obs is not None else None,
     }), flush=True)
     try:
         router.serve_forever()
     finally:
         _teardown()
+        if obs is not None:
+            obs.close()
+            for line in obs.slo.report().splitlines():
+                logger.info("%s", line)
         if args.trace_export and span_log is not None:
             from torch_actor_critic_tpu.telemetry.traceview import (
                 export_trace,

@@ -1,0 +1,580 @@
+"""Run-wide observability plane tests (PR 19 / docs/OBSERVABILITY.md
+"Run-wide plane").
+
+Pins the obs/ contracts: the plane-generic snapshot fold survives every
+partial-failure shape (dead source, missing histogram, restarted worker)
+without raising or double-counting; the SLO engine arms on first pass
+and emits exactly one event per hysteresis transition; the collector
+counts scrape failures instead of crashing and serves its own /metrics;
+span ids stitch actor pushes to transport ingests to learner drains;
+JSONL sinks rotate by size with a counted marker; and obs OFF keeps the
+Trainer metrics keys bit-identical to a build without the subsystem.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torch_actor_critic_tpu.obs import (
+    ObsCollector,
+    SLOEngine,
+    SLORule,
+    actor_span_events,
+    aggregate_snapshots,
+    default_rules,
+    http_source,
+    load_rules,
+)
+from torch_actor_critic_tpu.obs.merge import flatten_numeric
+from torch_actor_critic_tpu.obs.slo import dig
+from torch_actor_critic_tpu.telemetry.histogram import FixedBucketHistogram
+from torch_actor_critic_tpu.telemetry.sinks import JsonlSink
+from torch_actor_critic_tpu.telemetry.traceview import (
+    ACTOR_PID_BASE,
+    TRANSPORT_PID,
+    RequestSpanLog,
+    staging_span_events,
+)
+
+
+def _hist(values):
+    h = FixedBucketHistogram()
+    for v in values:
+        h.record(v)
+    return h.raw_counts()
+
+
+def _snap(i, extra=None):
+    out = {
+        "requests_total": 10 * (i + 1),
+        "sheds_total": i,
+        "queue_depth": 2,
+        "requests_per_sec": 5.0,
+        "latency_hist": _hist([1.0 * (i + 1)] * 10),
+    }
+    out.update(extra or {})
+    return out
+
+
+# --------------------------------------------------------- snapshot fold
+
+
+def test_merge_worker_dying_mid_scrape_is_labelled_not_fatal():
+    """Satellite 3: a source that died mid-scrape (None snapshot) is
+    labelled unreachable, excluded from every total, and never raises."""
+    agg = aggregate_snapshots({"w0": _snap(0), "w1": None, "w2": _snap(2)})
+    assert agg["sources"]["w1"] == {"unreachable": True}
+    assert agg["sources_reporting"] == 2
+    assert agg["requests_total"] == 10 + 30  # live sources only
+    assert agg["queue_depth"] == 4
+    assert agg["requests_per_sec"] == 10.0
+    # Histogram merged from the live pair only.
+    assert agg["p99_ms"] == pytest.approx(3.0, rel=0.2)
+
+
+def test_merge_missing_latency_hist_is_fine():
+    """A plane with no latency histogram (the learner) still folds."""
+    snap = {"requests_total": 3}
+    agg = aggregate_snapshots({"a": snap, "b": _snap(1)})
+    assert agg["requests_total"] == 3 + 20
+    assert "latency_merge_error" not in agg
+    # No percentile keys when only one source had samples? They come
+    # from the merged estimator, which did get b's samples.
+    assert agg["p50_ms"] is not None
+
+
+def test_merge_restarted_worker_never_double_counts():
+    """Counters sum over CURRENT snapshots: a restarted source's reset
+    counters simply replace its old contribution — the aggregate can
+    never double-count a dead incarnation."""
+    before = aggregate_snapshots(
+        {"w0": {"requests_total": 100}, "w1": {"requests_total": 50}}
+    )
+    assert before["requests_total"] == 150
+    # w1 restarts (counters reset to 7): the fold reflects exactly the
+    # live processes, not 50 + 7.
+    after = aggregate_snapshots(
+        {"w0": {"requests_total": 100}, "w1": {"requests_total": 7}}
+    )
+    assert after["requests_total"] == 107
+
+
+def test_merge_hist_spec_mismatch_recorded_never_raised():
+    bad = {"requests_total": 1, "latency_hist": {"counts": "garbage"}}
+    agg = aggregate_snapshots({"w0": _snap(0), "w1": bad})
+    assert "latency_merge_error" in agg
+    assert agg["requests_total"] == 11  # both sources' counters intact
+    assert agg["sources_reporting"] == 2
+
+
+def test_merge_dynamic_mode_discovers_counter_shaped_keys():
+    """sum_keys=None (the cross-plane mode) sums every *_total / depth
+    leaf it discovers — including flattened paths — and leaves plain
+    gauges alone."""
+    a = {"staging/staged_total": 5, "epoch": 9, "queue_depth": 1}
+    b = {"staging/staged_total": 7, "epoch": 4, "other_gauge": 2.5}
+    agg = aggregate_snapshots({"a": a, "b": b})
+    assert agg["staging/staged_total"] == 12
+    assert agg["queue_depth"] == 1
+    assert "epoch" not in agg or agg["epoch"] != 13  # gauges never sum
+    assert "other_gauge" not in agg
+
+
+def test_flatten_numeric_nests_bools_and_histogram():
+    snap = {
+        "a": 1,
+        "ok": True,
+        "nested": {"x": 2.5, "deeper": {"y": 3, "past": {"z": 4}}},
+        "latency_hist": {"counts": {}},
+        "text": "skip me",
+    }
+    flat = flatten_numeric(snap)
+    assert flat["a"] == 1 and flat["ok"] == 1
+    assert flat["nested/x"] == 2.5
+    assert flat["nested/deeper/y"] == 3
+    assert "nested/deeper/past/z" not in flat  # depth cap
+    assert flat["latency_hist"] == {"counts": {}}  # rides through
+    assert "text" not in flat
+
+
+# ------------------------------------------------------------ SLO engine
+
+
+def _rule(**kw):
+    spec = dict(
+        name="goodput", path="learner.rate", op="min", threshold=10.0,
+        breach_windows=2, recover_windows=2,
+    )
+    spec.update(kw)
+    return SLORule(**spec)
+
+
+def test_slo_arm_on_first_pass_and_missing_ok():
+    """A rule emits nothing until its path first exists AND passes: no
+    breach storm while the fleet warms up, and a missing plane
+    (missing_ok) stays silent forever."""
+    eng = SLOEngine([_rule()], clock=lambda: 0.0)
+    # Path absent, then failing: still unarmed, zero events.
+    assert eng.observe({}) == []
+    assert eng.observe({"learner": {"rate": 1.0}}) == []
+    assert eng.observe({"learner": {"rate": 2.0}}) == []
+    assert eng.snapshot()["rules"]["goodput"]["armed"] is False
+    # First pass arms; subsequent failures then count toward breach.
+    assert eng.observe({"learner": {"rate": 50.0}}) == []
+    assert eng.snapshot()["rules"]["goodput"]["armed"] is True
+
+
+def test_slo_hysteresis_emits_exactly_one_event_per_transition():
+    eng = SLOEngine([_rule()], clock=lambda: 0.0)
+    eng.observe({"learner": {"rate": 50.0}})  # arm
+    assert eng.observe({"learner": {"rate": 1.0}}) == []  # 1 bad window
+    events = eng.observe({"learner": {"rate": 1.0}})      # 2nd: breach
+    assert [e["type"] for e in events] == ["slo_breach"]
+    assert events[0]["rule"] == "goodput"
+    assert events[0]["value"] == 1.0
+    # Staying bad emits nothing more.
+    assert eng.observe({"learner": {"rate": 0.5}}) == []
+    # One good window is not recovery yet; a flap resets the streak.
+    assert eng.observe({"learner": {"rate": 50.0}}) == []
+    assert eng.observe({"learner": {"rate": 1.0}}) == []
+    assert eng.observe({"learner": {"rate": 50.0}}) == []
+    events = eng.observe({"learner": {"rate": 50.0}})
+    assert [e["type"] for e in events] == ["slo_recovered"]
+    snap = eng.snapshot()
+    assert snap["breaches_total"] == 1
+    assert snap["active_breaches"] == 0
+    assert snap["rules"]["goodput"]["recoveries_total"] == 1
+
+
+def test_slo_delta_mode_judges_per_window_increase():
+    """Cumulative counters breach on their per-window RATE: a lifetime
+    total far above the threshold is fine while the increase is small."""
+    rule = _rule(name="sheds", path="s.sheds_total", op="max",
+                 threshold=10.0, mode="delta", breach_windows=1)
+    eng = SLOEngine([rule], clock=lambda: 0.0)
+    assert eng.observe({"s": {"sheds_total": 100_000}}) == []  # no delta yet
+    assert eng.observe({"s": {"sheds_total": 100_002}}) == []  # +2: arms, ok
+    events = eng.observe({"s": {"sheds_total": 100_100}})      # +98: breach
+    assert [e["type"] for e in events] == ["slo_breach"]
+    assert events[0]["value"] == 98.0
+
+
+def test_slo_bool_paths_coerce_for_invariant_rules():
+    assert dig({"fleet": {"healthz": {"conservation_ok": True}}},
+               "fleet.healthz.conservation_ok") == 1.0
+    assert dig({"a": {"b": "text"}}, "a.b") is None
+    assert dig({}, "a.b") is None
+    rule = SLORule("conserve", "fleet.healthz.conservation_ok", "min",
+                   1.0, breach_windows=1)
+    eng = SLOEngine([rule], clock=lambda: 0.0)
+    eng.observe({"fleet": {"healthz": {"conservation_ok": True}}})
+    events = eng.observe({"fleet": {"healthz": {"conservation_ok": False}}})
+    assert [e["type"] for e in events] == ["slo_breach"]
+
+
+def test_slo_load_rules_grammar_errors_are_loud(tmp_path):
+    def write(obj):
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps(obj))
+        return str(p)
+
+    ok = load_rules(write([{"name": "g", "path": "a.b", "op": "min",
+                            "threshold": 1}]))
+    assert len(ok) == 1 and ok[0].threshold == 1.0
+    with pytest.raises(ValueError, match="unknown keys"):
+        load_rules(write([{"name": "g", "path": "a", "op": "min",
+                           "threshold": 1, "thresold": 2}]))
+    with pytest.raises(ValueError, match="missing 'threshold'"):
+        load_rules(write([{"name": "g", "path": "a", "op": "min"}]))
+    with pytest.raises(ValueError, match="duplicate"):
+        load_rules(write([
+            {"name": "g", "path": "a", "op": "min", "threshold": 1},
+            {"name": "g", "path": "b", "op": "max", "threshold": 2},
+        ]))
+    with pytest.raises(ValueError, match="JSON list"):
+        load_rules(write({"name": "g"}))
+    with pytest.raises(ValueError, match="op must be"):
+        SLORule("x", "a.b", "median", 1.0)
+    with pytest.raises(ValueError, match="cannot load"):
+        load_rules(str(tmp_path / "missing.json"))
+
+
+def test_slo_report_and_defaults():
+    rules = default_rules()
+    assert len({r.name for r in rules}) == len(rules)
+    eng = SLOEngine(rules, clock=lambda: 0.0)
+    eng.observe({"learner": {"metrics": {"env_steps_per_sec": 100.0}}})
+    rep = eng.report()
+    assert "goodput_floor" in rep and "mfu_floor" in rep
+    assert "unarmed" in rep  # chip-only rules never engaged
+
+
+# ------------------------------------------------------------- collector
+
+
+def test_collector_counts_failures_and_merges_live_sources(tmp_path):
+    events_seen = []
+
+    class FakeTelemetry:
+        def event(self, type_, **fields):
+            events_seen.append((type_, fields))
+
+    rules = [SLORule("floor", "good.requests_total", "min", 1.0,
+                     breach_windows=1, recover_windows=1)]
+    col = ObsCollector(
+        interval_s=60.0, run_dir=str(tmp_path), rules=rules,
+        telemetry=FakeTelemetry(),
+    )
+    try:
+        state = {"requests_total": 5}
+        col.add_source("good", lambda: state)
+
+        def bad():
+            raise ConnectionError("boom")
+
+        col.add_source("bad", bad)
+        row = col.scrape_once()
+        assert row["sources"]["good"]["live"] is True
+        assert row["sources"]["bad"]["live"] is False
+        assert "boom" in row["sources"]["bad"]["last_error"]
+        assert row["bad"] == {"unreachable": True}
+        assert row["merged"]["requests_total"] == 5
+        assert row["merged"]["sources_reporting"] == 1
+        # SLO armed on the first pass; drop the counter to breach and
+        # check the event was forwarded to telemetry.
+        state["requests_total"] = 0
+        row = col.scrape_once()
+        assert [e["type"] for e in row["slo"]["events"]] == ["slo_breach"]
+        assert events_seen[0][0] == "slo_breach"
+        assert events_seen[0][1]["rule"] == "floor"
+        cols = col.metrics_columns()
+        assert cols["obs/scrapes_total"] == 2
+        assert cols["obs/scrape_failed_total"] == 2
+        assert cols["obs/sources_total"] == 2
+        assert cols["obs/sources_live"] == 1
+        assert cols["obs/slo_breaches_total"] == 1
+        assert cols["obs/slo_active"] == 1
+    finally:
+        col.close()
+    lines = (tmp_path / "obs.jsonl").read_text().splitlines()
+    rows = [json.loads(line) for line in lines]
+    assert [r["type"] for r in rows[:2]] == ["obs", "obs"]
+
+
+def test_collector_http_endpoint_and_dead_url_source():
+    col = ObsCollector(interval_s=60.0)
+    try:
+        col.add_source("learner", lambda: {"steps_total": 7})
+        # A dead URL is a counted scrape failure, never a crash.
+        col.add_source("dead", "http://127.0.0.1:1")
+        col.scrape_once()
+        scrape = http_source(col.address)
+        body = scrape()
+        assert body["scrapes_total"] == 1
+        assert body["scrape_failed_total"] == 1
+        assert body["sources"]["learner"]["live"] is True
+        assert body["sources"]["dead"]["live"] is False
+        assert body["last"]["merged"]["steps_total"] == 7
+        assert "slo" in body
+        with urllib.request.urlopen(col.address + "/healthz") as r:
+            health = json.loads(r.read().decode())
+        assert health == {"ok": True, "sources_live": 1,
+                          "sources_total": 2}
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(col.address + "/nope")
+    finally:
+        col.close()
+    # close() is idempotent and safe after shutdown.
+    col.close()
+
+
+def test_collector_http_source_extra_paths_nest_under_name():
+    col = ObsCollector(interval_s=60.0)
+    try:
+        col.add_source("x", lambda: {"n_total": 1})
+        col.scrape_once()
+        scrape = http_source(col.address, ("/metrics", "/healthz"))
+        body = scrape()
+        assert body["healthz"]["ok"] is True
+    finally:
+        col.close()
+
+
+# ---------------------------------------------------------- sink rotation
+
+
+def test_jsonl_sink_rotates_by_size_with_counted_marker(tmp_path):
+    path = tmp_path / "telemetry.jsonl"
+    sink = JsonlSink(path, max_bytes=300)
+    for i in range(30):
+        sink.write({"type": "epoch", "i": i, "pad": "x" * 40})
+    sink.close()
+    assert sink.rotations >= 1
+    assert (tmp_path / "telemetry.jsonl.1").exists()
+    # Only one generation is kept: worst case ~2x max_bytes on disk.
+    assert not (tmp_path / "telemetry.jsonl.2").exists()
+    lines = path.read_text().splitlines()
+    first = json.loads(lines[0])
+    assert first["type"] == "sink_rotated"
+    assert first["rotations"] == sink.rotations
+    # Every surviving line is strict JSON and the newest event is last.
+    assert json.loads(lines[-1])["i"] == 29
+    # Rotation bounds the live file near the budget.
+    assert path.stat().st_size <= 300 + 100
+
+
+def test_jsonl_sink_rotation_off_by_default(tmp_path):
+    sink = JsonlSink(tmp_path / "t.jsonl")
+    for i in range(50):
+        sink.write({"i": i, "pad": "x" * 40})
+    sink.close()
+    assert sink.rotations == 0
+    assert not (tmp_path / "t.jsonl.1").exists()
+    assert len((tmp_path / "t.jsonl").read_text().splitlines()) == 50
+
+
+# -------------------------------------------------------- trace stitching
+
+
+def _txn(i, n_envs=2, obs_dim=3, act_dim=1):
+    rng = np.random.default_rng(i)
+    return (
+        rng.standard_normal((n_envs, obs_dim)).astype(np.float32),
+        rng.standard_normal((n_envs, act_dim)).astype(np.float32),
+        rng.standard_normal((n_envs,)).astype(np.float32),
+        rng.standard_normal((n_envs, obs_dim)).astype(np.float32),
+        np.zeros((n_envs,), np.float32),
+    )
+
+
+class _ObsSpec:
+    shape = (3,)
+    dtype = np.dtype(np.float32)
+
+
+def test_span_ids_stitch_push_to_ingest_to_drain():
+    """The tentpole stitching contract: the actor's stage_push span,
+    the transport's stage_ingest span, and the learner's drain-window
+    tag list all carry the same ``a<actor>.<inc>.<seq>`` ids."""
+    from torch_actor_critic_tpu.decoupled import (
+        RemoteStagingClient,
+        StagingBuffer,
+        StagingTransportServer,
+    )
+    from torch_actor_critic_tpu.decoupled.transport import (
+        canonical_transition,
+    )
+
+    srv = StagingTransportServer(
+        StagingBuffer(8, policy="shed"), _ObsSpec(), n_envs=2, act_dim=1
+    )
+    srv.span_log = RequestSpanLog(64)
+    pushed = []
+    cli = RemoteStagingClient(
+        "http://unused", actor_id=3, incarnation=2,
+        post=lambda p, b, t: srv.handle_stage(b)[:2],
+    )
+    cli.span_sink = pushed.append
+    for i in range(3):
+        assert cli.put(canonical_transition(_txn(i), _ObsSpec()),
+                       generation=1, epoch=0) is True
+    want = ["a3.2.0", "a3.2.1", "a3.2.2"]
+    assert [r["span_id"] for r in pushed] == want
+    assert [r["outcome"] for r in pushed] == ["accepted"] * 3
+    assert all(r["dur_us"] >= 0 for r in pushed)
+    ingest = srv.span_log.records()
+    assert [r["span_id"] for r in ingest] == want
+    assert [r["name"] for r in ingest] == ["stage_ingest"] * 3
+    # The learner drains the very ids it consumed — once.
+    assert srv.take_recent_span_ids() == want
+    assert srv.take_recent_span_ids() == []
+
+
+def test_span_logging_off_is_a_pointer_check():
+    """No span_log / span_sink attached → no deque growth, no records,
+    unchanged staging semantics."""
+    from torch_actor_critic_tpu.decoupled import (
+        RemoteStagingClient,
+        StagingBuffer,
+        StagingTransportServer,
+    )
+    from torch_actor_critic_tpu.decoupled.transport import (
+        canonical_transition,
+    )
+
+    srv = StagingTransportServer(
+        StagingBuffer(8, policy="shed"), _ObsSpec(), n_envs=2, act_dim=1
+    )
+    cli = RemoteStagingClient(
+        "http://unused", actor_id=0,
+        post=lambda p, b, t: srv.handle_stage(b)[:2],
+    )
+    assert cli.put(canonical_transition(_txn(0), _ObsSpec()),
+                   generation=1, epoch=0) is True
+    assert srv.take_recent_span_ids() == []
+    assert srv.staging.conservation_holds()
+
+
+def test_transport_healthz_reports_conservation_and_depth():
+    """Satellite 1: /healthz carries the cross-process conservation
+    invariant + staging depth — the collector's SLO probe surface."""
+    from torch_actor_critic_tpu.decoupled import (
+        StagingBuffer,
+        StagingTransportServer,
+    )
+
+    srv = StagingTransportServer(
+        StagingBuffer(8, policy="shed"), _ObsSpec(), n_envs=2, act_dim=1
+    ).start()
+    try:
+        scrape = http_source(srv.address, ("/metrics", "/healthz"))
+        body = scrape()
+        assert body["healthz"]["conservation_ok"] is True
+        assert body["healthz"]["staging_depth"] == 0
+        assert body["healthz"]["status"] == "ok"
+    finally:
+        srv.close()
+
+
+def test_staging_span_events_absolute_and_perf_timestamps():
+    """Actor span files carry ABSOLUTE µs timestamps (no alien perf
+    anchor); learner/transport spans carry perf t0/t1. Both shapes
+    become B/E pairs with the span args preserved."""
+    recs = [
+        {"name": "stage_push", "ts_us": 1_000.0, "dur_us": 50.0,
+         "span_id": "a1.0.0", "actor_id": 1, "seq": 0},
+        {"name": "drain_window", "t0": 0.0, "t1": 0.001,
+         "span_ids": ["a1.0.0"], "entries": 50},
+    ]
+    events = staging_span_events(recs[:1], pid=ACTOR_PID_BASE + 1)
+    assert [e["ph"] for e in events] == ["B", "E"]
+    assert events[0]["pid"] == ACTOR_PID_BASE + 1
+    assert events[0]["args"]["span_id"] == "a1.0.0"
+    assert events[1]["ts"] - events[0]["ts"] == pytest.approx(50.0)
+    events = staging_span_events(recs[1:], pid=TRANSPORT_PID)
+    assert events[0]["args"]["span_ids"] == ["a1.0.0"]
+    assert events[0]["pid"] == TRANSPORT_PID
+
+
+def test_actor_span_events_reads_dir_and_skips_garbage(tmp_path):
+    good = tmp_path / "actor1-0.spans.jsonl"
+    good.write_text(
+        json.dumps({"name": "stage_push", "ts_us": 5.0, "dur_us": 1.0,
+                    "span_id": "a1.0.0", "actor_id": 1}) + "\n"
+        + "not json\n"
+    )
+    (tmp_path / "actor2-0.spans.jsonl").write_text("{{{\n")
+    events = actor_span_events(str(tmp_path))
+    assert [e["ph"] for e in events] == ["B", "E"]
+    assert events[0]["pid"] == ACTOR_PID_BASE + 1
+    assert actor_span_events(str(tmp_path / "missing")) == []
+
+
+# ----------------------------------------------------- trainer integration
+
+
+@pytest.fixture(scope="module")
+def obs_off_and_on(tmp_path_factory):
+    """One tiny run with the obs plane off and one on, sharing config."""
+    from torch_actor_critic_tpu.parallel import make_mesh
+    from torch_actor_critic_tpu.sac.trainer import Trainer
+    from torch_actor_critic_tpu.utils.config import SACConfig
+    from torch_actor_critic_tpu.utils.tracking import Tracker
+
+    tiny = dict(
+        hidden_sizes=(16, 16), batch_size=16, epochs=2,
+        steps_per_epoch=40, start_steps=10, update_after=10,
+        update_every=10, buffer_size=500, max_ep_len=100,
+    )
+    results = {}
+    for mode in ("off", "on"):
+        root = tmp_path_factory.mktemp(f"obs_{mode}")
+        tracker = Tracker(experiment="t", root=root)
+        cfg = SACConfig(**tiny, obs=(mode == "on"), obs_interval_s=0.2)
+        tr = Trainer(
+            "Pendulum-v1", cfg, mesh=make_mesh(dp=1), tracker=tracker,
+            seed=3,
+        )
+        try:
+            metrics = tr.train()
+        finally:
+            tr.close()
+        results[mode] = (tracker, metrics, tr.obs)
+    return results
+
+
+def test_obs_disabled_mode_is_true_noop(obs_off_and_on):
+    """The zero-overhead contract: obs off produces the same metrics
+    keys as a build without the subsystem and ZERO obs artifacts; obs
+    ON may ADD the ``obs/`` columns — and nothing else."""
+    tracker_off, m_off, obs_off = obs_off_and_on["off"]
+    tracker_on, m_on, obs_on = obs_off_and_on["on"]
+    assert obs_off is None
+    assert obs_on is not None
+    assert not any(k.startswith("obs/") for k in m_off)
+    assert sorted(m_off) == sorted(
+        k for k in m_on if not k.startswith("obs/")
+    )
+    assert not (tracker_off.run_dir / "obs.jsonl").exists()
+    assert (tracker_on.run_dir / "obs.jsonl").exists()
+
+
+def test_obs_enabled_run_scrapes_learner_and_writes_series(obs_off_and_on):
+    tracker_on, m_on, obs_on = obs_off_and_on["on"]
+    assert m_on["obs/sources_total"] >= 1
+    assert m_on["obs/sources_live"] >= 1
+    assert m_on["obs/scrape_failed_total"] == 0
+    rows = [
+        json.loads(line) for line in
+        (tracker_on.run_dir / "obs.jsonl").read_text().splitlines()
+    ]
+    assert rows and all(r["type"] == "obs" for r in rows)
+    assert rows[-1]["sources"]["learner"]["live"] is True
+    # At least one post-epoch scrape saw the learner's metric columns.
+    assert any("metrics" in r["learner"] for r in rows)
+    # The metrics.jsonl mirror carries the obs/ columns.
+    cols = tracker_on.metrics()[-1]
+    assert cols["obs/scrapes_total"] >= 1

@@ -114,6 +114,19 @@ def _actor_loop(
         retry_budget_s=float(opts.get("push_retry_s", 2.0)),
         rng=random.Random(base_seed),
     )
+    trace_dir = opts.get("trace_dir")
+    span_sink = None
+    if trace_dir:
+        # Trace stitching: one spans file per (actor, incarnation),
+        # absolute-µs records the learner's trace export merges onto
+        # this actor's own timeline lane (obs/tracecollect.py).
+        from torch_actor_critic_tpu.telemetry.sinks import JsonlSink
+
+        span_sink = JsonlSink(os.path.join(
+            str(trace_dir),
+            f"actor{actor_id}-{incarnation}.spans.jsonl",
+        ))
+        staging.span_sink = span_sink.write
     _maybe_flaky_post(staging, actor_id)
     client = PolicyClient(url=url, retries=1, backoff_s=0.05)
     pool = make_env_pool(env_name, n_envs, base_seed=base_seed)
@@ -165,6 +178,8 @@ def _actor_loop(
     finally:
         stop.set()
         hb.join(timeout=5.0)
+        if span_sink is not None:
+            span_sink.close()
         close = getattr(pool, "close", None)
         if close is not None:
             close()
@@ -527,6 +542,38 @@ class FleetTrainer(DecoupledTrainer):
         )
         self._restored_incarnations: t.Dict[int, int] = {}
         self._fleet_started = False
+        # Run-wide obs plane: the collector (built in Trainer.__init__,
+        # started at train() entry) scrapes the transport's /metrics +
+        # /healthz — staging conservation and per-actor liveness land
+        # in the aggregated series as the ``fleet`` source.
+        if self.obs is not None:
+            from torch_actor_critic_tpu.obs import http_source
+
+            self.obs.add_source(
+                "fleet",
+                http_source(
+                    self.transport.address, ("/metrics", "/healthz")
+                ),
+            )
+        # Trace stitching: with telemetry on, the transport records
+        # ingest spans + queues accepted span ids, the learner tags
+        # drain windows with the ids they consumed, and actor
+        # subprocesses append their push spans under the run dir —
+        # merged into one timeline by extra_trace_events().
+        self._stage_spans = None
+        self._trace_dir = None
+        if self.telemetry is not None:
+            from torch_actor_critic_tpu.telemetry.traceview import (
+                RequestSpanLog,
+            )
+
+            self.transport.span_log = RequestSpanLog(4096)
+            self._stage_spans = RequestSpanLog(2048)
+            tracker = self.tracker
+            if tracker is not None and getattr(tracker, "run_dir", None):
+                self._trace_dir = os.path.join(
+                    str(tracker.run_dir), "stage_spans"
+                )
         logger.info(
             "actor fleet: %d actors, transport at %s, heartbeat "
             "%.2fs/%.2fs, max restarts %d",
@@ -569,6 +616,7 @@ class FleetTrainer(DecoupledTrainer):
                 "heartbeat_interval_s": self.config.heartbeat_interval_s,
                 "act_timeout_s": self.config.actor_timeout_s,
                 "push_retry_s": self.config.actor_push_retry_s,
+                "trace_dir": self._trace_dir,
             }},
             daemon=True,
         )
@@ -582,6 +630,52 @@ class FleetTrainer(DecoupledTrainer):
                 start_incarnations=self._restored_incarnations
             )
         return super().train(render)
+
+    # ----------------------------------------------------- trace stitching
+
+    def _drain_window(self, staging):
+        """Tag each drain window with the span ids of the fleet pushes
+        accepted since the last one — the learner-side end of the
+        actor-push -> transport-ingest -> drain stitch. No span log
+        attached = exactly the parent's behavior."""
+        if self._stage_spans is None:
+            return super()._drain_window(staging)
+        t0 = time.perf_counter()
+        chunk = super()._drain_window(staging)
+        if chunk is not None:
+            span_ids = self.transport.take_recent_span_ids()
+            self._stage_spans.record({
+                "name": "drain_window",
+                "t0": t0,
+                "t1": time.perf_counter(),
+                "span_ids": span_ids,
+                "entries": self.config.update_every,
+            })
+        return chunk
+
+    def extra_trace_events(self) -> t.List[dict]:
+        """Staging-plane spans for the merged run timeline: transport
+        ingest spans, learner drain windows, and every actor process's
+        push-span file."""
+        from torch_actor_critic_tpu.obs.tracecollect import actor_span_events
+        from torch_actor_critic_tpu.telemetry.traceview import (
+            TRAIN_PID,
+            TRANSPORT_PID,
+            staging_span_events,
+        )
+
+        events = list(super().extra_trace_events())
+        if self.transport.span_log is not None:
+            events.extend(staging_span_events(
+                self.transport.span_log.records(), pid=TRANSPORT_PID
+            ))
+        if self._stage_spans is not None:
+            events.extend(staging_span_events(
+                self._stage_spans.records(), pid=TRAIN_PID
+            ))
+        if self._trace_dir is not None:
+            events.extend(actor_span_events(self._trace_dir))
+        return events
 
     # --------------------------------------------------------- checkpoint
 

@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import base64
 import binascii
+import collections
 import json
 import logging
 import math
@@ -309,6 +310,17 @@ class StagingTransportServer:
         self.shed_429_total = 0  # guarded-by: _lock
         self.heartbeats_total = 0  # guarded-by: _lock
         self.acts_total = 0  # guarded-by: _lock
+        # Trace stitching (PR 19): when a RequestSpanLog is attached
+        # (fleet runs with tracing on), every ACCEPTED push records an
+        # ingest span carrying its ``a<actor>.<incarnation>.<seq>``
+        # span id, and the id queues for the learner to tag onto the
+        # drain window that consumes it. Default None — the staging
+        # hot path pays one pointer check, the ``telemetry=None``
+        # contract.
+        self.span_log = None  # RequestSpanLog | None
+        self._recent_span_ids: t.Deque[str] = (  # guarded-by: _lock
+            collections.deque(maxlen=4096)
+        )
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -338,9 +350,17 @@ class StagingTransportServer:
             def do_GET(self):  # noqa: N802 — stdlib API
                 if self.path == "/healthz":
                     paused = server.staging.paused
+                    # Health, not just liveness (PR 19): the probe
+                    # carries the conservation invariant and depth so
+                    # one GET distinguishes "up" from "healthy" — the
+                    # ObsCollector scrapes this and the SLO engine
+                    # alarms on conservation_ok going false.
                     self._send(200, {
                         "status": "paused" if paused else "ok",
                         "staging_depth": server.staging.depth(),
+                        "conservation_ok": (
+                            server.staging.conservation_holds()
+                        ),
                         "actors": len(server.liveness()),
                     })
                 elif self.path == "/metrics":
@@ -443,6 +463,8 @@ class StagingTransportServer:
         """Validate -> dedup -> stage -> advance watermark; returns
         ``(status, payload, headers)``. Exposed for direct unit tests —
         the HTTP handler is a thin shim over this."""
+        span_log = self.span_log
+        t_ingest = time.perf_counter() if span_log is not None else 0.0
         try:
             actor_id = _require_int(body, "actor_id", minimum=0)
             incarnation = _require_int(body, "incarnation", minimum=0)
@@ -516,6 +538,7 @@ class StagingTransportServer:
                     "error": "staging backpressure shed",
                     "reason": "staging_shed",
                 }, {"Retry-After": "1"}
+            landed = False
             with self._lock:
                 if entry.incarnation != incarnation:
                     # Superseded mid-put: retire_actor's purge ran
@@ -528,9 +551,26 @@ class StagingTransportServer:
                     entry.seq = seq
                     entry.accepted_total += 1
                     self.accepted_total += 1
-                    return 200, {
-                        "accepted": True, "duplicate": False,
-                    }, None
+                    landed = True
+                    if span_log is not None:
+                        self._recent_span_ids.append(
+                            f"a{actor_id}.{incarnation}.{seq}"
+                        )
+            if landed:
+                if span_log is not None:
+                    span_log.record({
+                        "name": "stage_ingest",
+                        "t0": t_ingest,
+                        "t1": time.perf_counter(),
+                        "span_id": f"a{actor_id}.{incarnation}.{seq}",
+                        "actor_id": actor_id,
+                        "incarnation": incarnation,
+                        "seq": seq,
+                        "outcome": "accepted",
+                    })
+                return 200, {
+                    "accepted": True, "duplicate": False,
+                }, None
             # Still under entry.lock: the successor incarnation's
             # pushes are queued behind this lane, so the sweep can only
             # catch the zombie's own transition, never theirs.
@@ -633,6 +673,15 @@ class StagingTransportServer:
                 self._actors[int(aid)] = entry
 
     # ----------------------------------------------------- introspection
+
+    def take_recent_span_ids(self) -> t.List[str]:
+        """Drain the span ids of pushes accepted since the last call —
+        the learner tags them onto the drain-window span that consumed
+        them (trace stitching). Empty unless a span_log is attached."""
+        with self._lock:
+            ids = list(self._recent_span_ids)
+            self._recent_span_ids.clear()
+        return ids
 
     def snapshot(self) -> dict:
         now = self._clock()
@@ -753,6 +802,14 @@ class RemoteStagingClient:
         self._rng = rng if rng is not None else random.Random()
         self._post = post if post is not None else self._http_post
         self._next_seq = int(start_seq)
+        # Trace stitching (PR 19): when set, a callable fed one record
+        # per ACCEPTED push — the actor loop points it at a JsonlSink
+        # under the run dir so the learner's trace export can stitch
+        # this process's ``stage_push`` spans (same
+        # ``a<actor>.<incarnation>.<seq>`` id the transport stamps on
+        # its ingest span) into the one run timeline. Default None:
+        # the push hot path pays one pointer check.
+        self.span_sink: t.Callable[[dict], None] | None = None
         # Counted outcomes (client side of the sequence audit).
         self.pushes_total = 0
         self.accepted_total = 0
@@ -816,6 +873,9 @@ class RemoteStagingClient:
         deadline = time.monotonic() + budget
         attempt = 0
         self.pushes_total += 1
+        t_push = (
+            time.perf_counter() if self.span_sink is not None else 0.0
+        )
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -842,6 +902,8 @@ class RemoteStagingClient:
                         self.duplicates_total += 1
                     else:
                         self.accepted_total += 1
+                    if self.span_sink is not None:
+                        self._record_push_span(t_push, seq, out)
                     return True
                 if status == 429:
                     # Counted server-side shed; the transition is gone
@@ -880,6 +942,34 @@ class RemoteStagingClient:
             self.retries_total += 1
             attempt += 1
             self._sleep(delay)
+
+    def _record_push_span(self, t_push: float, seq: int, out: dict):
+        """One accepted push -> one span record, with ABSOLUTE
+        microsecond timestamps (this process anchors its own wall
+        clock) so the learner-side trace merge needs no alien perf
+        anchor. Sink failures must not break staging."""
+        import os
+
+        from torch_actor_critic_tpu.telemetry.traceview import perf_to_us
+
+        try:
+            self.span_sink({
+                "name": "stage_push",
+                "ts_us": perf_to_us(t_push),
+                "dur_us": (time.perf_counter() - t_push) * 1e6,
+                "span_id": (
+                    f"a{self.actor_id}.{self.incarnation}.{seq}"
+                ),
+                "actor_id": self.actor_id,
+                "incarnation": self.incarnation,
+                "seq": seq,
+                "outcome": (
+                    "duplicate" if out.get("duplicate") else "accepted"
+                ),
+                "os_pid": os.getpid(),
+            })
+        except Exception:  # noqa: BLE001 - tracing must never fail a push
+            logger.debug("push span record failed", exc_info=True)
 
     # --------------------------------------------------------- heartbeat
 

@@ -429,7 +429,8 @@ class Trainer:
                     and getattr(tracker, "enabled", False)
                     and is_coordinator()
                     else None
-                )
+                ),
+                sink_max_bytes=int(self.config.telemetry_max_mb * 1e6),
             )
         self.telemetry = telemetry
         # Compute-cost attribution (telemetry/costmodel.py): with
@@ -472,6 +473,38 @@ class Trainer:
         # latch, independent of the diagnostics tier (the watchdog's
         # _first_update_epoch only exists with diagnostics on).
         self._bundle_emitted = not self.config.emit_bundle
+        # Run-wide observability plane (obs/, docs/OBSERVABILITY.md
+        # "Run-wide plane"): built here but STARTED at train() entry,
+        # because fleet subclasses wire their transport/staging sources
+        # after super().__init__ returns — an early scrape would count
+        # failures against planes that are still being constructed.
+        # None when off: no thread, no socket, no obs/ metric keys.
+        self.obs = None
+        self._obs_last_metrics: t.Dict[str, t.Any] = {}
+        if self.config.obs:
+            from torch_actor_critic_tpu.obs import ObsCollector, load_rules
+
+            self.obs = ObsCollector(
+                interval_s=self.config.obs_interval_s,
+                run_dir=(
+                    tracker.run_dir
+                    if tracker is not None
+                    and getattr(tracker, "enabled", False)
+                    and is_coordinator()
+                    else None
+                ),
+                port=self.config.obs_port,
+                rules=(
+                    load_rules(self.config.slo_config)
+                    if self.config.slo_config else None
+                ),
+                telemetry=self.telemetry,
+                max_bytes=int(self.config.telemetry_max_mb * 1e6),
+            )
+            self.obs.add_source("learner", self._obs_learner_source)
+            for pair in filter(None, self.config.obs_scrape.split(",")):
+                name, _, url = pair.partition("=")
+                self.obs.add_source(name.strip(), url.strip())
 
         # One env per dp mesh slice, stepped as a pool: sequential
         # in-process by default, parallel worker processes over the
@@ -832,6 +865,35 @@ class Trainer:
         trainer publishes the epoch to the serving registry and merges
         staging/degradation metrics here)."""
 
+    # --------------------------------------------------- run-wide obs plane
+
+    def _obs_learner_source(self) -> dict:
+        """The learner plane's snapshot for the ObsCollector: telemetry
+        phase aggregates, any subclass metrics_snapshot (the decoupled
+        staging/transport view), and the numeric columns of the last
+        logged epoch — the paths SLO rules address as
+        ``learner.metrics.<key>``."""
+        out: t.Dict[str, t.Any] = {}
+        if self.telemetry is not None:
+            out["telemetry"] = self.telemetry.snapshot()
+        snap = getattr(self, "metrics_snapshot", None)
+        if callable(snap):
+            out.update(snap())
+        metrics = self._obs_last_metrics
+        if metrics:
+            out["metrics"] = {
+                k: v for k, v in metrics.items()
+                if isinstance(v, (int, float, bool))
+            }
+        return out
+
+    def extra_trace_events(self) -> t.List[dict]:
+        """Cross-process trace events beyond this process's own
+        recorder buffers — the fleet trainer returns its staging-plane
+        spans (transport ingest, drain windows, actor push files) here
+        so ``--trace-export`` merges every plane into one timeline."""
+        return []
+
     # ------------------------------------------------------ cost accounting
 
     def _note_epoch_cost(self, rec, last_metrics, n_bursts, epoch):
@@ -1090,6 +1152,11 @@ class Trainer:
         # Loop-local alias: the telemetry checks below compile to one
         # predicted `is not None` branch per phase mark when disabled.
         rec = self.telemetry
+        # Start the obs scraper here, not in __init__: every subclass
+        # (fleet transport, decoupled staging) has finished wiring its
+        # sources by the time super().train() runs.
+        if self.obs is not None:
+            self.obs.start()
 
         # Epoch-boundary seeds (resilience): a resumed run's fresh envs
         # reset exactly as the uninterrupted run's live envs were
@@ -1610,6 +1677,14 @@ class Trainer:
                 e, sentinel_ok, saved_this_epoch, last_metrics, rec
             )
 
+            # Run-wide obs plane: mirror the collector's flat summary
+            # into this epoch's metrics row, and hand the row back so
+            # the learner scrape source (and SLO paths like
+            # ``learner.metrics.env_steps_per_sec``) see real columns.
+            if self.obs is not None:
+                last_metrics.update(self.obs.metrics_columns())
+                self._obs_last_metrics = dict(last_metrics)
+
             # --emit-bundle: first epoch with real updates (losses_q
             # non-empty — NOT the watchdog's first-update latch, which
             # only exists with diagnostics on) builds the serve-plane
@@ -1702,6 +1777,12 @@ class Trainer:
 
         if self.checkpointer is not None:
             self.checkpointer.wait()
+        # One final obs window while every plane is still alive (the
+        # fleet transport dies in close()): a run faster than the
+        # scrape interval still ends with a row that saw real epoch
+        # metrics.
+        if self.obs is not None:
+            self.obs.scrape_once()
         return last_metrics
 
     def close(self):
@@ -1717,6 +1798,14 @@ class Trainer:
             self._prefetcher.close()
         if self.tiered is not None:
             self.tiered.close()
+        if self.obs is not None:
+            # One final window (a run shorter than the interval still
+            # gets a row), then the run-exit SLO table.
+            if self.obs.scrapes_total == 0:
+                self.obs.scrape_once()
+            self.obs.close()
+            for line in self.obs.slo.report().splitlines():
+                logger.info("%s", line)
         if self.telemetry is not None:
             self.telemetry.close()
         self.pool.close()

@@ -257,54 +257,26 @@ def aggregate_snapshots(
     histogram one process would have built from all the samples
     (pinned by tests/test_fleet.py). Workers whose snapshot failed
     (value ``None``) appear with ``{"unreachable": true}`` and
-    contribute nothing to the totals."""
-    out: t.Dict[str, t.Any] = {k: 0 for k in _SUM_KEYS}
-    out["shed_by_reason"] = {}
-    out["requests_per_sec"] = 0.0
-    per_worker: t.Dict[str, t.Any] = {}
-    merged = FixedBucketHistogram()
-    merge_error = None
-    for name, snap in workers.items():
-        if snap is None:
-            per_worker[name] = {"unreachable": True}
-            continue
-        per_worker[name] = {
-            k: snap.get(k) for k in _SUM_KEYS + (
-                "requests_per_sec", "shed_by_reason", "uptime_s",
-                "p50_ms", "p99_ms", "queue_capacity", "draining",
-            ) if k in snap
-        }
-        for k in _SUM_KEYS:
-            v = snap.get(k)
-            if isinstance(v, (int, float)):
-                out[k] += int(v)
-        for reason, n in (snap.get("shed_by_reason") or {}).items():
-            out["shed_by_reason"][reason] = (
-                out["shed_by_reason"].get(reason, 0) + int(n)
-            )
-        rps = snap.get("requests_per_sec")
-        if isinstance(rps, (int, float)):
-            out["requests_per_sec"] = round(
-                out["requests_per_sec"] + float(rps), 2
-            )
-        hist = snap.get("latency_hist")
-        if hist is not None:
-            try:
-                merged.merge_raw(hist)
-            except (ValueError, KeyError, TypeError) as e:
-                merge_error = repr(e)[:200]
-    if merged.count:
-        p50, p95, p99 = merged.percentiles((50, 95, 99))
-        out.update(
-            mean_ms=round(merged.mean, 3), p50_ms=round(p50, 3),
-            p95_ms=round(p95, 3), p99_ms=round(p99, 3),
-            max_ms=round(merged.max, 3),
-        )
-    out["latency_hist"] = merged.raw_counts()
-    if merge_error is not None:
-        out["latency_merge_error"] = merge_error
-    out["workers"] = per_worker
-    out["workers_reporting"] = sum(
-        1 for v in per_worker.values() if not v.get("unreachable")
+    contribute nothing to the totals.
+
+    Since PR 19 this is a thin delegate over the plane-generic
+    :func:`torch_actor_critic_tpu.obs.merge.aggregate_snapshots` —
+    the fold semantics were lifted there so the ObsCollector applies
+    them to every plane; this wrapper pins the serving key set."""
+    from torch_actor_critic_tpu.obs.merge import (
+        aggregate_snapshots as merge_snapshots,
     )
-    return out
+
+    return merge_snapshots(
+        workers,
+        sum_keys=_SUM_KEYS,
+        rate_keys=("requests_per_sec",),
+        merge_dict_keys=("shed_by_reason",),
+        hist_key="latency_hist",
+        label_keys=_SUM_KEYS + (
+            "requests_per_sec", "shed_by_reason", "uptime_s",
+            "p50_ms", "p99_ms", "queue_capacity", "draining",
+        ),
+        sources_key="workers",
+        reporting_key="workers_reporting",
+    )

@@ -171,6 +171,7 @@ class TelemetryRecorder:
         ring_capacity: int = 4096,
         profile_epochs: t.Optional[t.Tuple[int, int]] = None,
         clock: t.Callable[[], float] = time.perf_counter,
+        sink_max_bytes: int = 0,
     ):
         self.phases = tuple(phases)
         self._clock = clock
@@ -193,7 +194,10 @@ class TelemetryRecorder:
         self._attr_frac_sums = {"device": 0.0, "host": 0.0, "input": 0.0}
 
         self.sink = (
-            JsonlSink(str(run_dir) + "/telemetry.jsonl")
+            JsonlSink(
+                str(run_dir) + "/telemetry.jsonl",
+                max_bytes=sink_max_bytes,
+            )
             if run_dir is not None else None
         )
         self.profiler = ProfilerWindow(
@@ -353,6 +357,8 @@ class TelemetryRecorder:
             out["memory"] = self.last_memory
         if self.sink is not None:
             out["events_written"] = self.sink.events_written
+            if self.sink.rotations:
+                out["sink_rotations_total"] = self.sink.rotations
         return out
 
     def attribution_summary(self) -> dict | None:

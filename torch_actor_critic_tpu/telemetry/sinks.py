@@ -15,6 +15,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import time
 import typing as t
 
 __all__ = ["JsonlSink", "format_summary", "json_sanitize"]
@@ -49,13 +50,25 @@ class JsonlSink:
     Lazily opens on first write (a disabled-tracking run never creates
     the file), creates parent directories, and never raises out of
     :meth:`write` — losing a telemetry line must not kill an epoch.
+
+    ``max_bytes > 0`` enables size-based rotation (``--telemetry-max-mb``)
+    so multi-hour fleet runs bound their event-stream footprint: when the
+    next line would cross the limit the current file is renamed to
+    ``<path>.1`` (one generation kept — worst case ~2x ``max_bytes`` on
+    disk) and the fresh file opens with a counted ``sink_rotated`` marker
+    line, so a rotation is visible in the stream it truncated. Default
+    off: the append-only "one file per run" contract is unchanged unless
+    asked for.
     """
 
-    def __init__(self, path: str | os.PathLike):
+    def __init__(self, path: str | os.PathLike, max_bytes: int = 0):
         self.path = str(path)
+        self.max_bytes = int(max_bytes)
         self._fh: t.Optional[t.TextIO] = None
+        self._bytes = 0
         self.events_written = 0
         self.write_errors = 0
+        self.rotations = 0
 
     def write(self, event: dict) -> None:
         try:
@@ -64,11 +77,36 @@ class JsonlSink:
                 if parent:
                     os.makedirs(parent, exist_ok=True)
                 self._fh = open(self.path, "a")
-            self._fh.write(json.dumps(json_sanitize(event)) + "\n")
+                try:
+                    self._bytes = os.path.getsize(self.path)
+                except OSError:
+                    self._bytes = 0
+            data = json.dumps(json_sanitize(event)) + "\n"
+            if (
+                self.max_bytes > 0
+                and self._bytes > 0
+                and self._bytes + len(data) > self.max_bytes
+            ):
+                self._rotate()
+            self._fh.write(data)
             self._fh.flush()
+            self._bytes += len(data)
             self.events_written += 1
         except OSError:
             self.write_errors += 1
+
+    def _rotate(self) -> None:
+        self._fh.close()
+        os.replace(self.path, self.path + ".1")
+        self._fh = open(self.path, "a")
+        self._bytes = 0
+        self.rotations += 1
+        marker = json.dumps(
+            {"type": "sink_rotated", "time": time.time(),
+             "rotations": self.rotations}
+        ) + "\n"
+        self._fh.write(marker)
+        self._bytes += len(marker)
 
     def close(self) -> None:
         if self._fh is not None:

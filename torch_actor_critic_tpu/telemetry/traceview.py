@@ -46,14 +46,19 @@ __all__ = [
     "router_hop_events",
     "serve_request_events",
     "span_event",
+    "staging_span_events",
     "training_events",
 ]
 
-# trace_event pids: one fake "process" lane per plane.
+# trace_event pids: one fake "process" lane per plane. Actor
+# subprocesses get dynamic pids ACTOR_PID_BASE + actor_id, so a fleet
+# run's merged timeline shows each actor as its own process lane.
 TRAIN_PID = 1
 SERVE_PID = 2
 XLA_PID = 3
 ROUTER_PID = 4
+TRANSPORT_PID = 5
+ACTOR_PID_BASE = 100
 
 _ANCHOR: t.Tuple[float, float] | None = None
 _ANCHOR_LOCK = threading.Lock()
@@ -226,6 +231,47 @@ def router_hop_events(records: t.Iterable[dict]) -> t.List[dict]:
     return events
 
 
+def staging_span_events(
+    records: t.Iterable[dict], pid: int
+) -> t.List[dict]:
+    """Staging-plane span records -> trace events on ``pid``.
+
+    Accepts the records all three staging planes produce (PR 19 trace
+    stitching, docs/OBSERVABILITY.md "Run-wide plane"): each has a
+    ``name`` plus either absolute microsecond timestamps
+    (``ts_us``/``dur_us`` — actor processes anchor their own wall
+    clock before writing, so their files merge without this process's
+    anchor) or perf-clock bounds (``t0``/``t1`` — the transport's
+    ingest spans and the learner's drain windows, mapped through this
+    process's anchor). Stitch ids ride in ``args``: an actor push and
+    the transport ingest carry the same ``span_id``
+    (``a<actor>.<incarnation>.<seq>``); a learner ``drain_window``
+    carries the ``span_ids`` it consumed."""
+    events: t.List[dict] = []
+    for i, rec in enumerate(records):
+        name = rec.get("name")
+        if not name:
+            continue
+        if rec.get("ts_us") is not None:
+            ts_us = float(rec["ts_us"])
+            dur_us = float(rec.get("dur_us", 0.0))
+        elif rec.get("t0") is not None and rec.get("t1") is not None:
+            ts_us = perf_to_us(float(rec["t0"]))
+            dur_us = (float(rec["t1"]) - float(rec["t0"])) * 1e6
+        else:
+            continue
+        args = {
+            k: rec[k]
+            for k in ("span_id", "span_ids", "actor_id", "incarnation",
+                      "seq", "entries", "outcome", "os_pid")
+            if rec.get(k) is not None
+        }
+        events.extend(span_event(
+            str(name), ts_us, dur_us, pid, i % 64, args=args or None,
+        ))
+    return events
+
+
 def compile_events(records: t.Iterable[dict]) -> t.List[dict]:
     """Watchdog compile records (``{source, time, duration_s}``, wall
     clock) -> trace events on the XLA pid. The monitoring event fires
@@ -244,17 +290,26 @@ def compile_events(records: t.Iterable[dict]) -> t.List[dict]:
     return events
 
 
-def _metadata_events() -> t.List[dict]:
-    out = []
-    for pid, name in (
-        (TRAIN_PID, "train"), (SERVE_PID, "serve"),
-        (XLA_PID, "xla-compile"), (ROUTER_PID, "router"),
-    ):
-        out.append({
+def _metadata_events(extra_pids: t.Iterable[int] = ()) -> t.List[dict]:
+    named = {
+        TRAIN_PID: "train", SERVE_PID: "serve", XLA_PID: "xla-compile",
+        ROUTER_PID: "router", TRANSPORT_PID: "staging-transport",
+    }
+    rows = list(named.items())
+    for pid in sorted(set(extra_pids) - set(named)):
+        # Dynamic lanes: actor subprocess pids, anything else numeric.
+        rows.append((
+            pid,
+            f"actor{pid - ACTOR_PID_BASE}" if pid >= ACTOR_PID_BASE
+            else f"pid{pid}",
+        ))
+    return [
+        {
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
             "args": {"name": name},
-        })
-    return out
+        }
+        for pid, name in rows
+    ]
 
 
 def export_trace(path: str | os.PathLike, *event_lists: t.List[dict]) -> dict:
@@ -267,7 +322,7 @@ def export_trace(path: str | os.PathLike, *event_lists: t.List[dict]) -> dict:
         events.extend(lst)
     spans = [e for e in events if e.get("ph") in ("B", "E")]
     spans.sort(key=lambda e: (e["ts"], 0 if e["ph"] == "E" else 1))
-    merged = _metadata_events() + spans
+    merged = _metadata_events(e["pid"] for e in spans) + spans
     payload = {"traceEvents": merged, "displayTimeUnit": "ms"}
     path = str(path)
     parent = os.path.dirname(path)
@@ -286,6 +341,11 @@ def export_trace(path: str | os.PathLike, *event_lists: t.List[dict]) -> dict:
         "serve_spans": by_pid.get(SERVE_PID, 0),
         "compile_spans": by_pid.get(XLA_PID, 0),
         "router_spans": by_pid.get(ROUTER_PID, 0),
+        "transport_spans": by_pid.get(TRANSPORT_PID, 0),
+        "actor_spans": sum(
+            n for p, n in by_pid.items() if p >= ACTOR_PID_BASE
+        ),
+        "pids": sorted(by_pid),
     }
     logger.info(
         "trace exported: %s (%d train / %d serve / %d compile spans)",

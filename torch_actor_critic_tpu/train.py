@@ -226,12 +226,15 @@ def main(argv=None):
         telemetry_rec = TelemetryRecorder(
             run_dir=tracker.run_dir if tracker.enabled else None,
             profile_epochs=profile_window,
+            sink_max_bytes=int(config.telemetry_max_mb * 1e6),
         )
 
-    def export_trace_if_requested():
+    def export_trace_if_requested(extra_events=None):
         # Cross-plane Perfetto export (--trace-export): training phase
-        # spans from the recorder ring + every watchdog-attributed XLA
-        # compile, one timeline (telemetry/traceview.py).
+        # spans from the recorder ring, every watchdog-attributed XLA
+        # compile, and any cross-process staging spans the trainer
+        # collected (fleet runs: transport ingest, drain windows,
+        # actor push files) — one timeline (telemetry/traceview.py).
         if args.trace_export is None or not is_coordinator():
             return
         from torch_actor_critic_tpu.diagnostics.watchdog import get_watchdog
@@ -245,15 +248,19 @@ def main(argv=None):
             [training_events(telemetry_rec)]
             if telemetry_rec is not None else []
         )
+        if extra_events:
+            spans.append(extra_events)
         summary = export_trace(
             args.trace_export, *spans,
             compile_events(get_watchdog().compile_log()),
         )
         logger.info(
-            "trace exported to %s (%d train spans, %d compile spans) — "
-            "load at chrome://tracing or https://ui.perfetto.dev",
+            "trace exported to %s (%d train / %d compile / %d transport "
+            "/ %d actor spans) — load at chrome://tracing or "
+            "https://ui.perfetto.dev",
             summary["path"], summary["train_spans"],
-            summary["compile_spans"],
+            summary["compile_spans"], summary["transport_spans"],
+            summary["actor_spans"],
         )
 
     if config.offline:
@@ -434,7 +441,13 @@ def main(argv=None):
         )
         raise SystemExit(p.exit_code)
     finally:
-        export_trace_if_requested()
+        # Export BEFORE close: a fleet trainer's staging span buffers
+        # (and actor span files) are still attached; the finally also
+        # runs on Preempted, so a SIGTERM'd run still gets its
+        # timeline.
+        export_trace_if_requested(
+            trainer.extra_trace_events() if args.trace_export else None
+        )
         trainer.close()
         if guard is not None:
             guard.uninstall()

@@ -400,6 +400,31 @@ class SACConfig:
     # (must match the serve worker's --max-batch for the bundle to
     # cover its buckets; smokes shrink it to keep the build cheap).
     bundle_max_batch: int = 64
+    # Run-wide observability plane (obs/, docs/OBSERVABILITY.md
+    # "Run-wide plane"): `--obs` starts the ObsCollector — a scraper
+    # thread folding every process's /metrics (learner telemetry,
+    # staging transport + actors, any `--obs-scrape` extras like the
+    # serve router) into one obs.jsonl time series, an aggregated
+    # /metrics endpoint on `--obs-port` (0 = ephemeral), and `obs/`
+    # columns in metrics.jsonl. Off by default: zero threads, zero
+    # sockets, metric keys identical to a pre-PR-19 build (pinned by
+    # tests/test_obs.py; bench.py `obs_overhead` holds the enabled
+    # cost within the 5% bar).
+    obs: bool = False
+    obs_interval_s: float = 2.0
+    obs_port: int = 0
+    # Extra scrape targets, comma-separated `name=http://host:port`
+    # pairs — how a training-side collector watches a separately
+    # launched serving fleet's router.
+    obs_scrape: str = ""
+    # SLO rules over the aggregated series (obs/slo.py grammar); empty
+    # = built-in defaults (goodput floor, p99 ceiling, shed-rate
+    # ceiling, actor staleness, conservation, MFU floor).
+    slo_config: str = ""
+    # Size-based rotation for telemetry.jsonl / obs.jsonl (MB; 0 =
+    # off, the append-only one-file-per-run default). Rotation keeps
+    # one `.1` generation and writes a counted `sink_rotated` marker.
+    telemetry_max_mb: float = 0.0
 
     def __post_init__(self):
         if not (len(self.filters) == len(self.kernel_sizes) == len(self.strides)):
@@ -566,6 +591,25 @@ class SACConfig:
             raise ValueError(
                 f"fleet_port must be in [0, 65535], got {self.fleet_port}"
             )
+        if self.obs_interval_s <= 0:
+            raise ValueError(
+                f"obs_interval_s must be > 0, got {self.obs_interval_s}"
+            )
+        if not (0 <= self.obs_port <= 65535):
+            raise ValueError(
+                f"obs_port must be in [0, 65535], got {self.obs_port}"
+            )
+        if self.telemetry_max_mb < 0:
+            raise ValueError(
+                f"telemetry_max_mb must be >= 0, got "
+                f"{self.telemetry_max_mb}"
+            )
+        for pair in filter(None, self.obs_scrape.split(",")):
+            if "=" not in pair:
+                raise ValueError(
+                    f"obs_scrape entries must be name=url pairs, got "
+                    f"{pair!r}"
+                )
         if self.decoupled:
             if self.on_device:
                 raise ValueError(
