@@ -2,7 +2,7 @@
 # runner plus operational helpers. The reference's mlflow/tensorboard/
 # dvc/prefect UI stubs map to the file-based tracking under runs/.
 
-.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke shard-serve-smoke decouple-smoke visual-smoke scenario-smoke sanitize-smoke replay-smoke coldstart-smoke obs-smoke
+.PHONY: test test-fast bench bench-diff dryrun lint native clean tpu-smoke tpu-watch parity multihost serve serve-smoke fault-smoke trace-smoke diag-smoke chaos-smoke pop-smoke cost-smoke mesh-smoke fleet-smoke shard-serve-smoke decouple-smoke visual-smoke scenario-smoke sanitize-smoke replay-smoke coldstart-smoke obs-smoke elastic-smoke
 
 # Full matrix (CI runs this; ~14 min on a 2-thread host).
 test:
@@ -197,6 +197,17 @@ coldstart-smoke:
 # learner process lanes (docs/OBSERVABILITY.md "Run-wide plane").
 obs-smoke:
 	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
+# Elastic self-healing fleet end-to-end (docs/RESILIENCE.md
+# "Elasticity"): an SLO breach scales the serving fleet out from the
+# warm pool, a worker SIGKILLed mid-spike is absorbed with ZERO
+# dropped requests and a counted recovery, green windows drain one
+# worker back in; on the training plane an actor SIGKILL degrades the
+# run to the surviving slice (conservation green) and the slot is
+# re-admitted at an epoch boundary — every decision a schema-valid
+# event on the exported Perfetto elastic lane.
+elastic-smoke:
+	JAX_PLATFORMS=cpu python scripts/elastic_smoke.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

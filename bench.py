@@ -2106,6 +2106,151 @@ def bench_obs_overhead(budget_s=420.0):
     return out
 
 
+def bench_elastic(budget_s=120.0, windows=600, window_s=1.0):
+    """Elastic vs fixed fleet under a diurnal load curve
+    (docs/RESILIENCE.md "Elasticity"): the REAL ElasticController
+    drives a simulated fleet through two compressed day/night cycles
+    and is scored against a fixed mean-provisioned fleet on the three
+    axes the autoscaler trades — goodput, tail latency, and
+    worker-seconds paid.
+
+    Same philosophy as bench_fleet's simulated service time: the
+    decision plane under test (breach -> spawn, green streak ->
+    drain) is the production code path; only the workers are modeled
+    (fixed per-replica service rate, carried queue, bounded backlog
+    with shed), because on the 1-core bench host real workers would
+    measure the host, not the controller. Simulated clock, so the
+    whole curve costs milliseconds of wall time."""
+    import math
+
+    from torch_actor_critic_tpu.elastic import (
+        DecisionLog,
+        ElasticController,
+        ElasticPolicy,
+    )
+
+    cap = 50.0          # req/s one replica serves
+    base, peak = 20.0, 150.0
+    period = windows / 2  # two diurnal cycles across the run
+
+    def offered(w):
+        phase = (1.0 + math.sin(2.0 * math.pi * w / period
+                                - math.pi / 2.0)) / 2.0
+        return base + (peak - base) * phase
+
+    def run_config(elastic):
+        sim_now = [0.0]
+
+        class SimFleet:
+            """The modeled worker plane: replicas x cap req/s, a
+            carried queue bounded at one window of fleet capacity
+            (beyond that requests shed, as the real admission plane
+            would 503)."""
+
+            def __init__(self, n):
+                self.n = n
+                self.queue = 0.0
+                self.served = 0.0
+                self.shed = 0.0
+                self.worker_seconds = 0.0
+
+            def replicas(self):
+                return self.n
+
+            def queue_depth(self):
+                return self.queue
+
+            def scale_out(self, reason=""):
+                self.n += 1
+                return {"outcome": "spawned", "worker": f"sim{self.n}"}
+
+            def scale_in(self, reason=""):
+                self.n -= 1
+                return {"outcome": "draining"}
+
+            def step(self, load):
+                capacity = self.n * cap * window_s
+                backlog = self.queue + load * window_s
+                done = min(backlog, capacity)
+                rest = backlog - done
+                allowed = capacity  # one window of headroom
+                self.served += done
+                self.shed += max(0.0, rest - allowed)
+                self.queue = min(rest, allowed)
+                self.worker_seconds += self.n * window_s
+                # Latency proxy: queueing delay in front of the fleet
+                # plus a fixed service floor.
+                wait_s = (self.queue / (self.n * cap)) if self.n else 0.0
+                return 5.0 + wait_s * 1e3
+
+        fleet = SimFleet(2)
+        controller = None
+        if elastic:
+            controller = ElasticController(
+                fleet,
+                policy=ElasticPolicy(
+                    min_replicas=1, max_replicas=4,
+                    scale_out_cooldown_s=5.0,
+                    scale_in_cooldown_s=30.0,
+                    scale_in_ok_windows=10,
+                ),
+                log=DecisionLog(),
+                clock=lambda: sim_now[0],
+            )
+        lat_ms = []
+        breached = False
+        bad = 0
+        ok = 0
+        for w in range(windows):
+            load = offered(w)
+            lat_ms.append(fleet.step(load))
+            # The goodput-floor hysteresis the obs SLO engine would
+            # emit: falling behind the offered load for 2 windows
+            # breaches, 2 caught-up windows recover.
+            behind = fleet.queue > 0.5 * fleet.n * cap * window_s
+            bad = bad + 1 if behind else 0
+            ok = 0 if behind else ok + 1
+            events = []
+            if not breached and bad >= 2:
+                breached = True
+                events.append({"type": "slo_breach",
+                               "rule": "goodput_floor"})
+            elif breached and ok >= 2:
+                breached = False
+                events.append({"type": "slo_recovered",
+                               "rule": "goodput_floor"})
+            if controller is not None:
+                controller.observe_window({"slo": {"events": events}})
+            sim_now[0] += window_s
+        lat_ms.sort()
+        total = windows * window_s
+        row = {
+            "goodput_rps": round(fleet.served / total, 1),
+            "p99_ms": round(lat_ms[int(0.99 * (len(lat_ms) - 1))], 1),
+            "worker_seconds": round(fleet.worker_seconds, 1),
+            "shed_total": round(fleet.shed, 1),
+            "final_replicas": fleet.n,
+        }
+        if controller is not None:
+            snap = controller.snapshot()
+            row["scale_out_total"] = snap["scale_out_total"]
+            row["scale_in_total"] = snap["scale_in_total"]
+        return row
+
+    out = {
+        "windows": windows,
+        "window_s": window_s,
+        "replica_cap_rps": cap,
+        "offered_rps": {"base": base, "peak": peak},
+        "fixed": run_config(elastic=False),
+        "elastic": run_config(elastic=True),
+    }
+    log_point("elastic", dict(out["fixed"], variant="fixed"))
+    log_point("elastic", dict(out["elastic"], variant="elastic"))
+    log(f"elastic bench: {out}")
+    return out
+
+
 def bench_replay(budget_s=300.0):
     """Tiered-replay throughput (docs/REPLAY.md): the host-side costs
     the tier stack adds around the (unchanged) device ring — waterfall
@@ -2859,6 +3004,10 @@ _STAGES = {
         "telemetry_overhead": bench_telemetry_overhead()
     },
     "obs_overhead": lambda: {"obs_overhead": bench_obs_overhead()},
+    # Elastic vs fixed fleet over a simulated diurnal load curve
+    # (the real ElasticController deciding; goodput/p99/worker-
+    # seconds picked up by make bench-diff's direction rows).
+    "elastic": lambda: {"elastic": bench_elastic()},
     "diagnostics_overhead": lambda: {
         "diagnostics_overhead": bench_diagnostics_overhead()
     },
@@ -3273,6 +3422,17 @@ def main():
     )
     if res and "error" in res:
         diagnostics.append({"obs_stage_error": res.pop("error")})
+    if res:
+        out.update(res)
+
+    # 5c''. Elastic vs fixed fleet over a diurnal load curve (the real
+    # ElasticController on a simulated worker plane) — pure host-side
+    # decision logic, CPU-pinned like the other instrumentation stages.
+    res = run_stage_subprocess(
+        "elastic", 300, diagnostics, platform="cpu"
+    )
+    if res and "error" in res:
+        diagnostics.append({"elastic_stage_error": res.pop("error")})
     if res:
         out.update(res)
 

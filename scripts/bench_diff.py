@@ -48,7 +48,12 @@ LOWER_BETTER = re.compile(
     r"|^ms_per_lockstep_round$|overhead.*_pct$"
     # Obs plane: failed scrapes and SLO breaches regress the run even
     # when throughput holds (the collector itself must stay healthy).
-    r"|_failed_total$|breaches_total$)"
+    r"|_failed_total$|breaches_total$"
+    # Elastic plane (bench --stage=elastic): worker-seconds is the
+    # cost axis the autoscaler trades against goodput/p99 — paying
+    # more of it for the same curve is a regression. Shed requests
+    # regress goodput even when the served rate holds.
+    r"|worker_seconds$|shed_total$)"
 )
 
 

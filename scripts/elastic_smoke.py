@@ -1,0 +1,497 @@
+#!/usr/bin/env python
+"""Elastic self-healing fleet smoke (``make elastic-smoke``).
+
+Proves the PR-20 elastic plane end-to-end with REAL processes
+(docs/RESILIENCE.md "Elasticity"), both planes:
+
+Serving plane (serve.py --fleet 1 --warm-pool 2 --obs --elastic on):
+
+1. a single-worker fleet comes up behind the router with two pre-forked
+   warm spares and a tight ``goodput_floor`` SLO rule;
+2. a flood arms the rule, stopping it breaches -> the controller's
+   scale-out draws a warm spare and admits it through router
+   membership (fleet /metrics: ``elastic.scale_out_total`` >= 1);
+3. the flood resumes (spike) and one worker is SIGKILLed mid-spike:
+   the warm-pool monitor replaces it, the rule recovers (counted
+   ``slo_recovered``), and the counting load loops observe ZERO
+   dropped requests across the kill;
+4. the flood drops to a trickle: green windows accumulate and the
+   controller scales back in by DRAIN (never a kill) — still zero
+   drops — then SIGTERM exports a Perfetto timeline whose elastic
+   lane (pid 6) carries the scale_out and scale_in decision spans.
+
+Training plane (train.py --decoupled --actors 2 --elastic on
+--actor-max-restarts 0):
+
+5. an actor is SIGKILLed; with a zero restart budget the supervisor
+   gives up and the trainer DEGRADES to the surviving slice at the
+   next epoch boundary (conservation stays green — the dead actor's
+   staged tail is the invariant's dropped_dead_actor term);
+6. after ``elastic_readmit_epochs`` the slot is re-admitted with a
+   fresh budget and bumped incarnation; metrics.jsonl shows the
+   degraded window close (``elastic/degraded_slots`` back to 0),
+   telemetry.jsonl carries schema-valid ``elastic_decision`` events
+   for BOTH edges, and the exported trace has the degrade/readmit
+   spans on the elastic lane's train track.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error as urlerr
+import urllib.request as urlreq
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+OBS_DIM = 3   # Pendulum-v1
+ACT_DIM = 1
+
+DECISION_KEYS = ("seq", "plane", "action", "reason", "replicas_before",
+                 "replicas_after", "outcome")
+
+
+def log(msg):
+    print(f"[elastic-smoke] {msg}", flush=True)
+
+
+def fail(msg):
+    log(f"FAIL: {msg}")
+    sys.exit(1)
+
+
+def wait_for(predicate, what, timeout_s=300.0, poll_s=0.25):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(poll_s)
+    fail(f"timed out after {timeout_s:.0f}s waiting for {what}")
+
+
+def get_json(url, timeout=3):
+    try:
+        with urlreq.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except Exception:  # noqa: BLE001 - polling probe
+        return None
+
+
+def jsonl(path: Path):
+    if not path.exists():
+        return []
+    out = []
+    for line in path.read_text().splitlines():
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            pass
+    return out
+
+
+def build_checkpoint(ckpt_dir):
+    """A serve-able SAC checkpoint without a training run."""
+    import jax
+    import jax.numpy as jnp
+
+    from torch_actor_critic_tpu.models import Actor, DoubleCritic
+    from torch_actor_critic_tpu.sac import SAC
+    from torch_actor_critic_tpu.utils.checkpoint import Checkpointer
+    from torch_actor_critic_tpu.utils.config import SACConfig
+
+    cfg = SACConfig(hidden_sizes=(16, 16))
+    sac = SAC(
+        cfg, Actor(act_dim=ACT_DIM, hidden_sizes=(16, 16)),
+        DoubleCritic(hidden_sizes=(16, 16)), ACT_DIM,
+    )
+    state = sac.init_state(jax.random.key(0), jnp.zeros((OBS_DIM,)))
+    ck = Checkpointer(ckpt_dir, save_buffer=False)
+    ck.save(0, state, extra={"config": cfg.to_json()}, wait=True)
+    ck.close()
+
+
+def start_elastic_fleet(ckpt_dir, slo_path, trace_path, env):
+    """serve.py --fleet 1 --warm-pool 2 --elastic on; returns
+    (proc, startup dict)."""
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "serve.py"),
+         "--ckpt-dir", ckpt_dir,
+         "--obs-dim", str(OBS_DIM), "--act-dim", str(ACT_DIM),
+         "--fleet", "1", "--port", "0", "--router-poll", "0.5",
+         "--warm-pool", "2",
+         "--obs", "--obs-interval", "0.5",
+         "--slo-config", str(slo_path),
+         "--elastic", "on",
+         "--elastic-min", "1", "--elastic-max", "2",
+         "--elastic-out-cooldown", "2.0",
+         "--elastic-in-cooldown", "8.0",
+         "--elastic-in-windows", "6",
+         "--trace-export", str(trace_path),
+         "--max-batch", "4", "--max-wait-ms", "2",
+         "--poll-interval", "0"],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    startup = None
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                fail(f"fleet died rc={proc.returncode} before ready")
+            time.sleep(0.1)
+            continue
+        sys.stderr.write(f"[fleet] {line}")
+        if line.startswith("{"):
+            try:
+                startup = json.loads(line)
+                if "router" in startup:
+                    break
+            except json.JSONDecodeError:
+                continue
+    if startup is None:
+        fail("the fleet never printed its startup JSON")
+    threading.Thread(
+        target=lambda: [None for _ in proc.stdout], daemon=True
+    ).start()
+    return proc, startup
+
+
+def serving_phase(tmp, ckpt_dir, env):
+    """Scale-out on breach, kill-mid-spike with zero drops + counted
+    recovery, drain-based scale-in, elastic spans in the trace."""
+    trace_path = tmp / "serve_trace.json"
+    # The rule NAME must be in ElasticPolicy.scale_out_rules — that is
+    # how a breach becomes a spawn. Arm-on-first-pass: nothing fires
+    # until the flood starts.
+    slo_path = tmp / "slo.json"
+    slo_path.write_text(json.dumps([{
+        "name": "goodput_floor", "path": "router.requests_per_sec",
+        "op": "min", "threshold": 0.5,
+        "breach_windows": 2, "recover_windows": 2,
+    }]))
+
+    log("serving phase: fleet (1 worker + 2 warm spares, elastic on)")
+    fleet, startup = start_elastic_fleet(ckpt_dir, slo_path, trace_path, env)
+    if startup.get("elastic") != "on":
+        fail(f"startup JSON does not confirm elastic: {startup}")
+    router = startup["router"]
+    obs_url = startup["obs"]
+    if not obs_url:
+        fail("startup JSON carries no obs collector address")
+    initial_pids = startup["pids"]
+
+    flood_stop = threading.Event()
+    flood_level = [0]  # thread i floods only while i < flood_level[0]
+    drops = []  # each entry: one hard client-visible failure
+
+    def load_loop(i):
+        body = json.dumps(
+            {"obs": [0.1] * OBS_DIM, "deterministic": True}
+        ).encode()
+        while not flood_stop.is_set():
+            if i >= flood_level[0]:
+                time.sleep(0.05)
+                continue
+            req = urlreq.Request(
+                router + "/act", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urlreq.urlopen(req, timeout=30).read()
+            except urlerr.HTTPError as e:
+                if e.code != 503:  # shed is backpressure, not a drop
+                    drops.append(f"thread {i}: HTTP {e.code}")
+                time.sleep(0.1)
+            except Exception as e:  # noqa: BLE001 - the drop counter
+                drops.append(f"thread {i}: {type(e).__name__}: {e}")
+                time.sleep(0.1)
+
+    threads = [
+        threading.Thread(target=load_loop, args=(i,), daemon=True)
+        for i in range(3)
+    ]
+    for th in threads:
+        th.start()
+
+    def fleet_section():
+        m = get_json(router + "/metrics")
+        return None if m is None else m.get("fleet")
+
+    def rule_state():
+        m = get_json(obs_url + "/metrics")
+        if m is None:
+            return None
+        return m["slo"]["rules"]["goodput_floor"]
+
+    def reporting():
+        m = get_json(router + "/metrics")
+        return -1 if m is None else m.get("workers_reporting", -1)
+
+    try:
+        wait_for(lambda: reporting() == 1, "the initial worker")
+        wait_for(lambda: get_json(obs_url + "/metrics") is not None,
+                 "the obs collector endpoint")
+
+        # Satellite pin: the fleet /metrics section carries warm-pool
+        # spare readiness + last-refill status alongside the
+        # scaler/controller counters.
+        fl = wait_for(fleet_section, "the fleet /metrics section")
+        for key in ("warm_pool", "scaler", "elastic"):
+            if key not in fl:
+                fail(f"fleet /metrics section is missing {key!r}: {fl}")
+        for key in ("ready", "last_refill_ok", "last_refill_age_s"):
+            if key not in fl["warm_pool"]:
+                fail(f"warm_pool status is missing {key!r}: "
+                     f"{fl['warm_pool']}")
+        wait_for(lambda: (f := fleet_section()) is not None
+                 and f["warm_pool"]["ready"] >= 1,
+                 "a warm spare to become ready")
+
+        log("flood on (arm the goodput rule) ...")
+        flood_level[0] = 3
+        wait_for(lambda: (st := rule_state()) is not None and st["armed"],
+                 "the goodput_floor rule to arm")
+
+        log("flood off (breach -> elastic scale-out) ...")
+        flood_level[0] = 0
+        wait_for(lambda: (st := rule_state()) is not None
+                 and st["breached"], "the slo_breach")
+        wait_for(lambda: (f := fleet_section()) is not None
+                 and f["elastic"]["scale_out_total"] >= 1
+                 and f["scaler"]["spawned_total"] >= 1,
+                 "the controller's scale-out decision")
+        wait_for(lambda: reporting() == 2,
+                 "the drawn spare to join the fleet")
+        log("scale-out confirmed: 2 workers reporting")
+
+        log("flood on + SIGKILL a worker mid-spike ...")
+        flood_level[0] = 3
+        time.sleep(0.5)  # let the spike land on both workers
+        os.kill(initial_pids[0], signal.SIGKILL)
+        wait_for(lambda: (st := rule_state()) is not None
+                 and not st["breached"]
+                 and st["recoveries_total"] >= 1,
+                 "the counted slo_recovered")
+        # The monitor's warm-spare replacement restores the fleet.
+        wait_for(lambda: reporting() == 2,
+                 "the kill-replacement spare")
+        if drops:
+            fail(f"{len(drops)} dropped requests across the kill "
+                 f"(first: {drops[0]})")
+        log("recovery confirmed: worker killed mid-spike, zero drops, "
+            "slo_recovered counted")
+
+        log("trickle load (green windows -> drain-based scale-in) ...")
+        flood_level[0] = 1
+        wait_for(lambda: (f := fleet_section()) is not None
+                 and f["elastic"]["scale_in_total"] >= 1
+                 and f["scaler"]["drained_total"] >= 1,
+                 "the controller's scale-in decision", timeout_s=300)
+        wait_for(lambda: reporting() == 1,
+                 "the drained worker to leave membership")
+        if drops:
+            fail(f"scale-in dropped {len(drops)} accepted requests "
+                 f"(first: {drops[0]})")
+        fl = fleet_section()
+        if fl["scaler"]["force_kills_total"] != 0:
+            fail(f"scale-in escalated to force-kill: {fl['scaler']}")
+        log("scale-in confirmed: drain-based, zero drops")
+    finally:
+        flood_stop.set()
+        if fleet.poll() is None:
+            fleet.send_signal(signal.SIGTERM)
+        try:
+            fleet.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            fleet.kill()
+
+    # The exported timeline: decision spans on the elastic lane.
+    if not trace_path.exists():
+        fail("the fleet exported no trace")
+    trace = json.loads(trace_path.read_text())["traceEvents"]
+    elastic_spans = [
+        e for e in trace if e.get("ph") == "B" and e.get("pid") == 6
+    ]
+    names = {e["name"] for e in elastic_spans}
+    if "elastic scale_out" not in names or "elastic scale_in" not in names:
+        fail(f"elastic lane is missing decision spans: {sorted(names)}")
+    for e in elastic_spans:
+        missing = [k for k in ("action", "plane", "outcome", "seq")
+                   if k not in e.get("args", {})]
+        if missing:
+            fail(f"elastic span {e['name']} args missing {missing}")
+    log(f"serve trace OK: {len(elastic_spans)} decision spans on the "
+        f"elastic lane ({sorted(names)})")
+    return drops
+
+
+def training_phase(tmp, env):
+    """Actor SIGKILL -> degrade to surviving slice (conservation
+    green) -> readmit at an epoch boundary with a bumped incarnation."""
+    runs_root = tmp / "runs"
+    trace_path = tmp / "train_trace.json"
+    log("training phase: fleet learner (--actors 2 --elastic on, "
+        "zero restart budget)")
+    learner = subprocess.Popen(
+        [sys.executable, "-m", "torch_actor_critic_tpu.train",
+         "--environment", "Pendulum-v1",
+         "--hidden-sizes", "16,16", "--batch-size", "16",
+         "--epochs", "120", "--steps-per-epoch", "100",
+         "--start-steps", "20", "--update-after", "20",
+         "--update-every", "20", "--buffer-size", "2000",
+         "--max-ep-len", "100",
+         "--decoupled", "true", "--actors", "2",
+         "--actor-max-restarts", "0",
+         "--elastic", "on", "--elastic-readmit-epochs", "1",
+         "--telemetry", "true",
+         "--trace-export", str(trace_path),
+         "--runs-root", str(runs_root), "--experiment", "elastic"],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+
+    try:
+        run_dir = wait_for(
+            lambda: next(iter((runs_root / "elastic").glob("*")), None),
+            "the learner run dir",
+        )
+
+        # The per-epoch "fleet" telemetry event carries the supervisor
+        # stats — actor id -> {incarnation, pid, alive} — which is how
+        # an operator (and this harness) maps a slot to a killable pid.
+        def fleet_events():
+            return [e for e in jsonl(run_dir / "telemetry.jsonl")
+                    if e.get("type") == "fleet"]
+
+        def live_actors():
+            evs = fleet_events()
+            if not evs:
+                return None
+            actors = evs[-1].get("supervisor", {}).get("actors", {})
+            live = {aid: a for aid, a in actors.items()
+                    if a.get("alive") and a.get("pid")}
+            return live if len(live) >= 2 else None
+
+        actors = wait_for(live_actors,
+                          "both actors alive in the fleet telemetry",
+                          timeout_s=600)
+        wait_for(lambda: len(jsonl(run_dir / "metrics.jsonl")) >= 1,
+                 "the first epoch metrics line")
+
+        victim_aid = sorted(actors)[0]
+        victim_pid = actors[victim_aid]["pid"]
+        log(f"SIGKILL actor {victim_aid} (pid {victim_pid}) ...")
+        os.kill(victim_pid, signal.SIGKILL)
+
+        def degraded_row():
+            rows = jsonl(run_dir / "metrics.jsonl")
+            return next((r for r in rows
+                         if r.get("elastic/degraded_slots", 0) >= 1), None)
+
+        row = wait_for(degraded_row,
+                       "the degrade edge in metrics.jsonl", timeout_s=600)
+        if row.get("decoupled/conservation_ok") != 1.0:
+            fail(f"conservation broke across the degrade: {row}")
+        log(f"degraded to the surviving slice at step "
+            f"{row.get('step')} with conservation green")
+
+        def restored_row():
+            rows = jsonl(run_dir / "metrics.jsonl")
+            return next((r for r in rows
+                         if r.get("elastic/readmit_total", 0) >= 1
+                         and r.get("elastic/degraded_slots", 1) == 0), None)
+
+        row = wait_for(restored_row,
+                       "the readmit edge in metrics.jsonl", timeout_s=600)
+        if row.get("decoupled/conservation_ok") != 1.0:
+            fail(f"conservation broke across the readmit: {row}")
+
+        def readmitted_incarnation():
+            evs = fleet_events()
+            if not evs:
+                return None
+            a = evs[-1].get("supervisor", {}).get(
+                "actors", {}).get(victim_aid, {})
+            return a if a.get("incarnation", 0) >= 1 else None
+
+        a = wait_for(readmitted_incarnation,
+                     "the re-admitted actor's bumped incarnation",
+                     timeout_s=600)
+        log(f"slot {victim_aid} re-admitted at step {row.get('step')} "
+            f"(incarnation {a['incarnation']})")
+
+        log("SIGTERM the learner; expect the trace export ...")
+        learner.send_signal(signal.SIGTERM)
+        rc = learner.wait(timeout=600)
+        if rc not in (0, 75):
+            fail(f"learner exited rc={rc}, expected 0 or requeue 75")
+    finally:
+        if learner.poll() is None:
+            learner.send_signal(signal.SIGTERM)
+            try:
+                learner.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                learner.kill()
+
+    # Schema-valid decision events for BOTH edges.
+    events = jsonl(run_dir / "telemetry.jsonl")
+    decisions = [e for e in events if e.get("type") == "elastic_decision"]
+    actions = {e.get("action") for e in decisions}
+    if "degrade" not in actions or "readmit" not in actions:
+        fail(f"telemetry.jsonl decision actions: {sorted(actions)} "
+             f"(wanted degrade + readmit)")
+    for e in decisions:
+        missing = [k for k in DECISION_KEYS if k not in e]
+        if missing:
+            fail(f"elastic_decision event missing {missing}: {e}")
+    degrade = next(e for e in decisions if e["action"] == "degrade")
+    readmit = next(e for e in decisions if e["action"] == "readmit")
+    if degrade["time"] >= readmit["time"]:
+        fail("degrade did not precede readmit")
+
+    # The train track of the elastic lane in the exported trace.
+    if not trace_path.exists():
+        fail("the learner exported no trace")
+    trace = json.loads(trace_path.read_text())["traceEvents"]
+    train_spans = [
+        e for e in trace
+        if e.get("ph") == "B" and e.get("pid") == 6
+    ]
+    names = {e["name"] for e in train_spans}
+    if "elastic degrade" not in names or "elastic readmit" not in names:
+        fail(f"train elastic lane is missing spans: {sorted(names)}")
+    log(f"train trace OK: {len(train_spans)} decision spans "
+        f"({sorted(names)})")
+    return decisions
+
+
+def main():
+    tmp = Path(tempfile.mkdtemp(prefix="elastic_smoke_"))
+    ckpt_dir = str(tmp / "ckpts")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    log("building a serve-able checkpoint ...")
+    build_checkpoint(ckpt_dir)
+
+    serving_phase(tmp, ckpt_dir, env)
+    training_phase(tmp, env)
+
+    log("ALL OK: breach-driven scale-out from the warm pool, a "
+        "mid-spike SIGKILL absorbed with zero dropped requests and a "
+        "counted recovery, drain-based scale-in, and a training-plane "
+        "degrade/readmit cycle with conservation green — every "
+        "decision a schema-valid event on the Perfetto elastic lane")
+
+
+if __name__ == "__main__":
+    main()

@@ -146,6 +146,31 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                      help="JSON list of SLO rules for the obs "
                           "collector (obs/slo.py grammar; default: "
                           "built-in rule set)")
+    flt.add_argument("--elastic", choices=("off", "on"), default="off",
+                     help="SLO-driven elastic autoscaling (elastic/; "
+                          "docs/RESILIENCE.md 'Elasticity'): an "
+                          "ElasticController subscribes to the obs "
+                          "collector's scrape windows — a breached "
+                          "scale-out rule draws a warm spare into "
+                          "rotation; sustained all-green windows "
+                          "drain the newest worker (zero accepted "
+                          "requests dropped). Needs --obs and "
+                          "--warm-pool >= 1")
+    flt.add_argument("--elastic-min", type=int, default=1,
+                     help="Elastic lower replica bound (scale-in "
+                          "never goes below it)")
+    flt.add_argument("--elastic-max", type=int, default=4,
+                     help="Elastic upper replica bound (breaches past "
+                          "it are counted as bounded, not actuated)")
+    flt.add_argument("--elastic-out-cooldown", type=float, default=10.0,
+                     help="Per-rule scale-out cooldown seconds (a "
+                          "second, different rule can still fire)")
+    flt.add_argument("--elastic-in-cooldown", type=float, default=30.0,
+                     help="Scale-in cooldown seconds")
+    flt.add_argument("--elastic-in-windows", type=int, default=5,
+                     help="Consecutive all-green scrape windows "
+                          "required before a scale-in is considered "
+                          "(hysteresis)")
     srv.add_argument("--buckets", type=str, default=None,
                      help="Comma-separated bucket sizes (default: powers "
                           "of two up to max-batch)")
@@ -291,6 +316,9 @@ def _worker_argv(argv, worker: int | None = None):
     take_value = (
         "--fleet", "--port", "--router-poll", "--warm-pool",
         "--obs-port", "--obs-interval", "--slo-config",
+        "--elastic", "--elastic-min", "--elastic-max",
+        "--elastic-out-cooldown", "--elastic-in-cooldown",
+        "--elastic-in-windows",
     )
     out, skip = [], False
     for a in src:
@@ -390,6 +418,19 @@ def run_fleet(args, argv):
 
     from torch_actor_critic_tpu.serve.router import FleetRouter
 
+    if args.elastic == "on":
+        if not args.obs:
+            raise SystemExit(
+                "--elastic on needs --obs (the controller consumes "
+                "the obs collector's SLO scrape windows)"
+            )
+        if args.warm_pool < 1:
+            raise SystemExit(
+                "--elastic on needs --warm-pool >= 1 (scale-out "
+                "draws warm spares; it never cold-spawns on the "
+                "serving path)"
+            )
+
     workers, worker_lock = [], threading.Lock()
     for i in range(args.fleet):
         workers.append(_spawn_worker(argv, i))
@@ -439,6 +480,8 @@ def run_fleet(args, argv):
     # booted, warmed worker waiting off-rotation; the monitor below
     # draws one the moment a live worker dies.
     pool = None
+    scaler = controller = decision_log = None
+    worker_names = {}  # id(proc) -> router worker name (monitor thread)
     monitor_stop = threading.Event()
     if args.warm_pool > 0:
         from torch_actor_critic_tpu.aot import WarmPool
@@ -460,6 +503,48 @@ def run_fleet(args, argv):
 
         pool = WarmPool(_spawn_spare, _kill_worker, size=args.warm_pool)
 
+        # SLO-driven elasticity (elastic/; docs/RESILIENCE.md): the
+        # controller rides the obs scrape thread via window_hook —
+        # with --elastic off the hook stays None and the scrape loop
+        # pays a single is-None pointer check per window (no threads,
+        # no sockets, no metric keys: the off-parity contract).
+        if args.elastic == "on":
+            from torch_actor_critic_tpu.elastic import (
+                DecisionLog,
+                ElasticController,
+                ElasticPolicy,
+                FleetScaler,
+            )
+
+            decision_log = DecisionLog()
+            scaler = FleetScaler(
+                router, pool, obs=obs,
+                drain_exit_timeout_s=args.drain_timeout + 30,
+                obs_source=http_source,
+            )
+            for i, (proc, addr) in enumerate(zip(workers, addresses)):
+                worker_names[id(proc)] = f"w{i}"
+                scaler.register(f"w{i}", proc, addr)
+            controller = ElasticController(
+                scaler,
+                policy=ElasticPolicy(
+                    min_replicas=args.elastic_min,
+                    max_replicas=args.elastic_max,
+                    scale_out_cooldown_s=args.elastic_out_cooldown,
+                    scale_in_cooldown_s=args.elastic_in_cooldown,
+                    scale_in_ok_windows=args.elastic_in_windows,
+                ),
+                log=decision_log, plane="serve",
+            )
+            obs.window_hook = controller.observe_window
+            logger.info(
+                "elastic controller on: replicas [%d, %d], out-cooldown "
+                "%.1fs, in after %d green windows + %.1fs cooldown",
+                args.elastic_min, args.elastic_max,
+                args.elastic_out_cooldown, args.elastic_in_windows,
+                args.elastic_in_cooldown,
+            )
+
         def _monitor():
             handled = set()
             while not monitor_stop.wait(max(args.router_poll, 0.2)):
@@ -470,6 +555,14 @@ def run_fleet(args, argv):
                     ]
                 for proc in dead:
                     handled.add(id(proc))
+                    if scaler is not None:
+                        # The scaler must stop counting the corpse as
+                        # a replica before the controller's next
+                        # window, or scale-out math runs against a
+                        # phantom worker.
+                        dead_name = worker_names.pop(id(proc), None)
+                        if dead_name is not None:
+                            scaler.forget(dead_name)
                     drawn = pool.draw(timeout=30.0)
                     if drawn is None:
                         logger.warning(
@@ -481,6 +574,9 @@ def run_fleet(args, argv):
                     with worker_lock:
                         workers.append(drawn.handle)
                     name = router.add_worker(drawn.address)
+                    worker_names[id(drawn.handle)] = name
+                    if scaler is not None:
+                        scaler.register(name, drawn.handle, drawn.address)
                     if obs is not None:
                         obs.add_source(name, http_source(drawn.address))
                     logger.info(
@@ -493,12 +589,37 @@ def run_fleet(args, argv):
             target=_monitor, name="warm-pool-monitor", daemon=True
         ).start()
 
+    # Satellite /metrics surface: with a warm pool (and, on top, the
+    # elastic controller) the router's aggregated /metrics grows a
+    # "fleet" section — spare readiness + last-refill status, scaler
+    # counters, controller snapshot. Both features off leaves
+    # fleet_extra None and the key absent (off-parity pin).
+    if pool is not None:
+        def _fleet_extra():
+            out = {"warm_pool": pool.stats()}
+            if scaler is not None:
+                out["scaler"] = scaler.stats()
+            if controller is not None:
+                out["elastic"] = controller.snapshot()
+            return out
+
+        router.fleet_extra = _fleet_extra
+
     def _teardown(signum=None, frame=None):
         monitor_stop.set()
         if pool is not None:
             pool.shutdown()
         with worker_lock:
             procs = list(workers)
+        if scaler is not None:
+            # Elastic-spawned workers live in the scaler's registry,
+            # not the spawn-order list; sweep them into the same
+            # SIGTERM drain (dedup by identity — the initial fleet is
+            # registered in both).
+            known = {id(p) for p in procs}
+            procs.extend(
+                h for h in scaler.handles() if id(h) not in known
+            )
         logger.info("fleet teardown: draining %d workers", len(procs))
         for proc in procs:
             if proc.poll() is None:
@@ -508,6 +629,8 @@ def run_fleet(args, argv):
                 proc.wait(timeout=args.drain_timeout + 30)
             except subprocess.TimeoutExpired:
                 proc.kill()
+        if scaler is not None:
+            scaler.shutdown(join_timeout=5.0)
         router._httpd.shutdown()
 
     signal.signal(signal.SIGTERM, lambda s, f: threading.Thread(
@@ -522,6 +645,7 @@ def run_fleet(args, argv):
         "pids": pids,
         "warm_pool": pool.stats() if pool is not None else None,
         "obs": obs.address if obs is not None else None,
+        "elastic": args.elastic,
     }), flush=True)
     try:
         router.serve_forever()
@@ -533,16 +657,22 @@ def run_fleet(args, argv):
                 logger.info("%s", line)
         if args.trace_export and span_log is not None:
             from torch_actor_critic_tpu.telemetry.traceview import (
+                elastic_decision_events,
                 export_trace,
                 router_hop_events,
             )
 
-            summary = export_trace(
-                args.trace_export, router_hop_events(span_log.records())
-            )
+            event_groups = [router_hop_events(span_log.records())]
+            if decision_log is not None:
+                event_groups.append(
+                    elastic_decision_events(decision_log.records())
+                )
+            summary = export_trace(args.trace_export, *event_groups)
             logger.info(
-                "router trace exported to %s (%d hop spans)",
+                "router trace exported to %s (%d hop spans, %d "
+                "elastic spans)",
                 summary["path"], summary["router_spans"],
+                summary.get("elastic_spans", 0),
             )
 
 

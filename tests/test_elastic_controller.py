@@ -1,0 +1,839 @@
+"""Elastic self-healing fleet tests (PR 20 / docs/RESILIENCE.md
+"Elasticity").
+
+Pins the scale state machine with an injected clock and fake actuators
+(breach-edge scale-out, per-rule cooldowns, min/max bounds, hysteresis
+scale-in with the queue low-watermark); the DecisionLog schema and its
+telemetry forwarding; the FleetScaler's actuation ORDER (drain before
+SIGTERM — the zero-drop property — plus reaper-side removal and
+force-kill escalation); a real-worker scale-in under concurrent load
+dropping zero accepted requests; the supervisor's budget-reset
+readmit; the training-plane degrade/re-admit manager with its
+checkpoint round-trip; the topology helpers; and the --elastic off
+parity pins (no config surface, no router /metrics keys, no window
+hook).
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from torch_actor_critic_tpu.decoupled.fleet import FleetSupervisor
+from torch_actor_critic_tpu.elastic import (
+    DECISION_FIELDS,
+    DecisionLog,
+    ElasticController,
+    ElasticPolicy,
+    FleetScaler,
+    TrainingElasticManager,
+)
+from torch_actor_critic_tpu.parallel.distributed import (
+    plan_degraded_resume,
+    topology_snapshot,
+)
+from torch_actor_critic_tpu.telemetry.traceview import (
+    ELASTIC_PID,
+    elastic_decision_events,
+)
+from torch_actor_critic_tpu.utils.config import SACConfig
+
+
+def wait_until(pred, timeout=30.0, msg="condition never held"):
+    deadline = time.time() + timeout
+    while not pred():
+        assert time.time() < deadline, msg
+        time.sleep(0.002)
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _breach(rule):
+    return {"type": "slo_breach", "rule": rule, "path": "x", "op": "min",
+            "mode": "value", "threshold": 1.0, "value": 0.0, "window": 1}
+
+
+def _recover(rule):
+    return {"type": "slo_recovered", "rule": rule, "path": "x",
+            "op": "min", "mode": "value", "threshold": 1.0, "value": 2.0,
+            "window": 1}
+
+
+def _window(*events):
+    return {"type": "obs", "slo": {"events": list(events)}}
+
+
+class _FakeActuator:
+    """Replica-count arithmetic stand-in for the controller units."""
+
+    def __init__(self, replicas=1, depth=0.0):
+        self._replicas = replicas
+        self.depth = depth
+        self.out_calls = []
+        self.in_calls = []
+
+    def replicas(self):
+        return self._replicas
+
+    def queue_depth(self):
+        return self.depth
+
+    def scale_out(self, reason=""):
+        self.out_calls.append(reason)
+        self._replicas += 1
+        return {"outcome": "spawned", "worker": f"w{self._replicas - 1}"}
+
+    def scale_in(self, reason=""):
+        self.in_calls.append(reason)
+        self._replicas -= 1
+        return {"outcome": "draining", "worker": f"w{self._replicas}"}
+
+
+def _controller(replicas=1, depth=0.0, **policy_kw):
+    clock = _Clock()
+    act = _FakeActuator(replicas=replicas, depth=depth)
+    pol = dict(
+        min_replicas=1, max_replicas=4, scale_out_cooldown_s=10.0,
+        scale_in_cooldown_s=30.0, scale_in_ok_windows=3,
+        queue_low_watermark=1.0,
+    )
+    pol.update(policy_kw)
+    ctl = ElasticController(
+        act, policy=ElasticPolicy(**pol), clock=clock,
+    )
+    return ctl, act, clock
+
+
+# ---------------------------------------------------------- controller
+
+
+def test_breach_edge_scales_out_and_persistent_breach_refires():
+    """The breach EDGE triggers a spawn; the edge is folded into an
+    active-breach set, so a still-active breach (no further events)
+    re-triggers only after the per-rule cooldown."""
+    ctl, act, clock = _controller()
+    decisions = ctl.observe_window(_window(_breach("goodput_floor")))
+    assert [d["action"] for d in decisions] == ["scale_out"]
+    assert decisions[0]["rule"] == "goodput_floor"
+    assert decisions[0]["replicas_before"] == 1
+    assert decisions[0]["replicas_after"] == 2
+    assert act.out_calls == ["slo_breach:goodput_floor"]
+    # Still breached, inside the cooldown: no storm of spawns.
+    clock.t += 5.0
+    assert ctl.observe_window(_window()) == []
+    # Past the cooldown, breach never recovered: fire again.
+    clock.t += 6.0
+    decisions = ctl.observe_window(_window())
+    assert [d["action"] for d in decisions] == ["scale_out"]
+    assert act.replicas() == 3
+
+
+def test_cooldown_is_per_rule_not_global():
+    ctl, act, clock = _controller()
+    ctl.observe_window(_window(_breach("goodput_floor")))
+    clock.t += 1.0
+    # A DIFFERENT rule breaching inside the first rule's cooldown
+    # still actuates.
+    decisions = ctl.observe_window(_window(_breach("p99_ceiling")))
+    assert [d["rule"] for d in decisions] == ["p99_ceiling"]
+    assert act.replicas() == 3
+
+
+def test_scale_out_holds_at_max_replicas_counted_not_actuated():
+    ctl, act, clock = _controller(replicas=4)
+    assert ctl.observe_window(_window(_breach("p99_ceiling"))) == []
+    assert act.out_calls == []
+    assert ctl.snapshot()["bounded_total"] == 1
+    # The hold consumed the rule's cooldown too: the NEXT window does
+    # not retry until it elapses (no per-window warning spam).
+    clock.t += 1.0
+    assert ctl.observe_window(_window()) == []
+    assert ctl.snapshot()["bounded_total"] == 1
+
+
+def test_rule_outside_scale_out_set_never_spawns_but_blocks_scale_in():
+    ctl, act, clock = _controller(replicas=2)
+    assert ctl.observe_window(_window(_breach("conservation_ok"))) == []
+    assert act.out_calls == []
+    # The active (non-scaling) breach still vetoes scale-in forever.
+    clock.t += 1000.0
+    for _ in range(10):
+        assert ctl.observe_window(_window()) == []
+    assert act.in_calls == []
+
+
+def test_scale_in_needs_green_streak_watermark_and_cooldown():
+    ctl, act, clock = _controller(replicas=3, depth=100.0)
+    ctl.observe_window(_window(_breach("p99_ceiling")))  # -> 4 replicas
+    clock.t += 100.0
+    # Recovery edge: streak starts counting green windows.
+    assert ctl.observe_window(_window(_recover("p99_ceiling"))) == []
+    assert ctl.observe_window(_window()) == []
+    # Streak satisfied (3 ok windows) but the fleet backlog is above
+    # the low watermark: hold.
+    assert ctl.observe_window(_window()) == []
+    assert act.in_calls == []
+    # Backlog drains below watermark * replicas: the NEXT green window
+    # drains one worker.
+    act.depth = 0.5
+    decisions = ctl.observe_window(_window())
+    assert [d["action"] for d in decisions] == ["scale_in"]
+    assert act.replicas() == 3
+    # The streak re-armed AND the scale-in cooldown holds: three more
+    # green windows inside the cooldown do nothing.
+    clock.t += 1.0
+    for _ in range(4):
+        assert ctl.observe_window(_window()) == []
+    # Past the cooldown the retained green streak fires immediately
+    # (consecutive green windows kept counting while the cooldown
+    # held; only an actuation or a breach resets them).
+    clock.t += 30.0
+    decisions = ctl.observe_window(_window())
+    assert [d["action"] for d in decisions] == ["scale_in"]
+    assert act.replicas() == 2
+
+
+def test_scale_in_never_goes_below_min_replicas():
+    ctl, act, clock = _controller(replicas=1, depth=0.0)
+    clock.t += 1000.0
+    for _ in range(20):
+        assert ctl.observe_window(_window()) == []
+    assert act.in_calls == []
+    assert act.replicas() == 1
+
+
+def test_actuator_fault_is_contained_never_raises():
+    class _Broken(_FakeActuator):
+        def scale_out(self, reason=""):
+            raise RuntimeError("spawn exploded")
+
+    ctl = ElasticController(_Broken(), clock=_Clock())
+    assert ctl.observe_window(_window(_breach("goodput_floor"))) == []
+    assert ctl.snapshot()["windows_total"] == 1
+
+
+def test_controller_snapshot_shape():
+    ctl, act, clock = _controller()
+    ctl.observe_window(_window(_breach("goodput_floor")))
+    snap = ctl.snapshot()
+    assert snap["replicas"] == 2
+    assert snap["scale_out_total"] == 1
+    assert snap["scale_in_total"] == 0
+    assert snap["decisions_total"] == 1
+    assert snap["last_action"] == "scale_out"
+    assert snap["last_rule"] == "goodput_floor"
+    assert snap["active_breach_rules"] == 1
+
+
+def test_elastic_policy_validation():
+    with pytest.raises(ValueError, match="min_replicas"):
+        ElasticPolicy(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        ElasticPolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="scale_in_ok_windows"):
+        ElasticPolicy(scale_in_ok_windows=0)
+    with pytest.raises(ValueError, match="scale_out_cooldown_s"):
+        ElasticPolicy(scale_out_cooldown_s=-1.0)
+
+
+# --------------------------------------------------------- decision log
+
+
+def test_decision_log_schema_counts_and_telemetry_forwarding():
+    events = []
+
+    class _Tel:
+        def event(self, name, **fields):
+            events.append((name, fields))
+
+    log = DecisionLog(telemetry=_Tel())
+    rec = log.record(
+        "scale_out", "serve", "slo_breach:p99_ceiling",
+        rule="p99_ceiling", replicas_before=1, replicas_after=2,
+        outcome="spawned", worker="w1",
+    )
+    for field in DECISION_FIELDS:
+        assert field in rec, field
+    assert rec["seq"] == 1
+    name, fields = events[0]
+    assert name == "elastic_decision"
+    assert "t0" not in fields  # perf-clock internals stay out of events
+    for field in DECISION_FIELDS:
+        assert field in fields, field
+    log.record("scale_out", "serve", "slo_breach:x", outcome="no_spare")
+    counts = log.counts()
+    assert counts["scale_out"] == 2
+    assert counts["scale_out_no_spare"] == 1
+    assert counts["decisions_total"] == 2
+    with pytest.raises(ValueError, match="unknown elastic action"):
+        log.record("explode", "serve", "nope")
+
+
+def test_decision_records_render_as_perfetto_spans_on_elastic_lane():
+    log = DecisionLog()
+    log.record("scale_out", "serve", "slo_breach:p99_ceiling",
+               rule="p99_ceiling", replicas_before=1, replicas_after=2,
+               outcome="spawned", worker="w1", dur_s=0.25)
+    log.record("degrade", "train", "restart_budget_exhausted",
+               outcome="degraded", actor_id=1, epoch=7)
+    events = elastic_decision_events(log.records())
+    assert [e["ph"] for e in events] == ["B", "E", "B", "E"]
+    assert all(e["pid"] == ELASTIC_PID for e in events)
+    serve_b, _, train_b, _ = events
+    assert serve_b["name"] == "elastic scale_out"
+    assert serve_b["tid"] == 0  # serving sub-lane
+    assert serve_b["args"]["worker"] == "w1"
+    assert serve_b["args"]["outcome"] == "spawned"
+    assert train_b["name"] == "elastic degrade"
+    assert train_b["tid"] == 1  # training sub-lane
+    assert train_b["args"]["actor_id"] == 1
+
+
+# -------------------------------------------------------- fleet scaler
+
+
+class _FakeHandle:
+    def __init__(self, name):
+        self.name = name
+        self.terminated = threading.Event()
+        self.killed = threading.Event()
+        self.exits = True  # wait() outcome
+
+    def terminate(self):
+        self.terminated.set()
+
+    def kill(self):
+        self.killed.set()
+
+    def wait(self, timeout=None):
+        if not self.exits:
+            raise TimeoutError("still running")
+        return 0
+
+
+class _FakePool:
+    def __init__(self, spares):
+        self.spares = list(spares)
+
+    def draw(self, timeout=None):
+        return self.spares.pop(0) if self.spares else None
+
+
+class _FakeRouter:
+    """Membership + drain bookkeeping; records actuation ORDER."""
+
+    def __init__(self):
+        self.workers = {}
+        self.calls = []
+        self._next = 0
+
+    def add_worker(self, url):
+        name = f"w{self._next}"
+        self._next += 1
+        self.workers[name] = {"admitted": True, "queue_depth": 0,
+                              "url": url}
+        self.calls.append(("add", name))
+        return name
+
+    def drain_worker(self, name):
+        self.calls.append(("drain", name))
+        w = self.workers.get(name)
+        if w is None:
+            return None
+        w["admitted"] = False
+        return w["url"]
+
+    def remove_worker(self, name):
+        self.calls.append(("remove", name))
+        if name not in self.workers:
+            raise KeyError(name)
+        del self.workers[name]
+
+    def membership(self):
+        return {"workers": {n: dict(w) for n, w in self.workers.items()}}
+
+
+class _FakeObs:
+    def __init__(self):
+        self.sources = {}
+
+    def add_source(self, name, source):
+        self.sources[name] = source
+
+    def remove_source(self, name):
+        self.sources.pop(name, None)
+
+
+def _warm(name):
+    from torch_actor_critic_tpu.aot.prefork import WarmWorker
+
+    return WarmWorker(_FakeHandle(name), f"http://{name}:1")
+
+
+def test_scaler_scale_out_draws_admits_and_registers_obs_source():
+    router, obs = _FakeRouter(), _FakeObs()
+    scaler = FleetScaler(router, _FakePool([_warm("spare0")]), obs=obs)
+    h0 = _FakeHandle("w-initial")
+    router.add_worker("http://init:1")
+    scaler.register("w0", h0, "http://init:1")
+    assert scaler.replicas() == 1
+    out = scaler.scale_out(reason="slo_breach:p99_ceiling")
+    assert out["outcome"] == "spawned"
+    assert out["worker"] == "w1"
+    assert scaler.replicas() == 2
+    assert "w1" in obs.sources  # the new worker joins the scrape set
+    assert ("add", "w1") in router.calls
+
+
+def test_scaler_scale_out_without_spare_is_counted_not_blocking():
+    router = _FakeRouter()
+    scaler = FleetScaler(router, _FakePool([]), draw_timeout_s=0.01)
+    out = scaler.scale_out(reason="slo_breach:x")
+    assert out == {"outcome": "no_spare"}
+    assert scaler.stats()["no_spare_total"] == 1
+    assert router.calls == []  # nothing was admitted
+
+
+def test_scaler_scale_in_drains_before_terminate_then_reaps():
+    """The zero-drop order: the victim leaves rotation (admin-hold
+    eject) BEFORE its process sees SIGTERM, and only after the exit
+    does the reaper forget it router- and obs-side."""
+    router, obs = _FakeRouter(), _FakeObs()
+    scaler = FleetScaler(router, _FakePool([]), obs=obs,
+                         drain_exit_timeout_s=5.0)
+    h0, h1 = _FakeHandle("h0"), _FakeHandle("h1")
+    for h, url in ((h0, "http://a:1"), (h1, "http://b:1")):
+        name = router.add_worker(url)
+        scaler.register(name, h, url)
+        obs.add_source(name, url)
+    out = scaler.scale_in(reason="ok_windows:5")
+    assert out["outcome"] == "draining"
+    assert out["worker"] == "w1"  # newest admitted worker is the victim
+    # Replica count drops the moment the victim is marked draining.
+    assert scaler.replicas() == 1
+    drain_i = router.calls.index(("drain", "w1"))
+    assert h1.terminated.wait(5.0)
+    # Drain strictly precedes remove; terminate happened after drain
+    # (the call list had no remove yet when SIGTERM fired).
+    wait_until(lambda: ("remove", "w1") in router.calls)
+    assert drain_i < router.calls.index(("remove", "w1"))
+    scaler.shutdown()
+    assert "w1" not in obs.sources
+    assert "w1" not in router.workers
+    assert not h1.killed.is_set()  # graceful exit: no escalation
+    assert scaler.stats()["workers"] == 1
+    assert scaler.stats()["force_kills_total"] == 0
+
+
+def test_scaler_scale_in_escalates_to_force_kill_on_hung_worker():
+    router = _FakeRouter()
+    scaler = FleetScaler(router, _FakePool([]),
+                         drain_exit_timeout_s=0.05)
+    h = _FakeHandle("hung")
+    h.exits = False
+    name = router.add_worker("http://hung:1")
+    scaler.register(name, h, "http://hung:1")
+    # min bound is the controller's job; the scaler obeys the order.
+    scaler.scale_in(reason="ok_windows:5")
+    wait_until(h.killed.is_set, msg="force kill never fired")
+    wait_until(lambda: scaler.stats()["force_kills_total"] == 1)
+    scaler.shutdown()
+
+
+def test_scaler_scale_in_with_no_admitted_candidate():
+    router = _FakeRouter()
+    scaler = FleetScaler(router, _FakePool([]))
+    assert scaler.scale_in(reason="x") == {"outcome": "no_candidate"}
+    # A draining worker is not a candidate either.
+    h = _FakeHandle("h")
+    h.exits = False
+    name = router.add_worker("http://a:1")
+    scaler.register(name, h, "http://a:1")
+    scaler.scale_in(reason="x")
+    assert scaler.scale_in(reason="x") == {"outcome": "no_candidate"}
+    scaler.shutdown(join_timeout=0.1)
+
+
+# ----------------------------------------- zero-drop scale-in, real fleet
+
+
+def _real_worker():
+    """One in-process PolicyServer worker (the test_fleet.py idiom)."""
+    import jax
+    import jax.numpy as jnp
+
+    from torch_actor_critic_tpu.models import Actor
+    from torch_actor_critic_tpu.serve import ModelRegistry, PolicyServer
+
+    actor = Actor(act_dim=6, hidden_sizes=(32, 32))
+    params = actor.init(
+        jax.random.key(0), jnp.zeros((17,)), jax.random.key(1)
+    )
+    reg = ModelRegistry()
+    reg.register(
+        "default", actor, jax.ShapeDtypeStruct((17,), jnp.float32),
+        params=params, max_batch=4, warmup=False,
+    )
+    srv = PolicyServer(reg, port=0, max_batch=4, max_wait_ms=1.0)
+    srv.start()
+    return srv
+
+
+def test_elastic_scale_in_drops_zero_accepted_requests():
+    """Scale-in against REAL workers under concurrent load: the victim
+    is ejected from rotation before it is torn down, so every client
+    request during the drain is answered (the ISSUE's pinned
+    invariant: scale-in never drops an accepted request)."""
+    import numpy as np
+
+    from torch_actor_critic_tpu.serve import FleetRouter as RealRouter
+    from torch_actor_critic_tpu.serve import PolicyClient
+
+    w0, w1 = _real_worker(), _real_worker()
+    router = RealRouter(
+        [w0.address, w1.address], poll_interval_s=30.0,  # manual polls
+    )
+    router.poll_once()
+    router.start()
+    servers = {"w0": w0, "w1": w1}
+    scaler = FleetScaler(
+        router, _FakePool([]),
+        terminate=lambda srv: srv.close(),
+        wait_exit=lambda srv, timeout: True,
+        force_kill=lambda srv: None,
+    )
+    scaler.register("w0", w0, w0.address)
+    scaler.register("w1", w1, w1.address)
+    obs = np.ones((17,), np.float32)
+    errors, answered = [], [0]
+    stop = threading.Event()
+
+    def load_loop():
+        client = PolicyClient(url=router.address, retries=3)
+        while not stop.is_set():
+            try:
+                res = client.act(obs, timeout=30.0)
+                assert res.action.shape == (6,)
+                answered[0] += 1
+            except Exception as e:  # noqa: BLE001 — recorded, asserted
+                errors.append(repr(e))
+    try:
+        herd = [threading.Thread(target=load_loop) for _ in range(3)]
+        for th in herd:
+            th.start()
+        wait_until(lambda: answered[0] >= 5)  # load is flowing
+        out = scaler.scale_in(reason="ok_windows:5")
+        assert out["outcome"] == "draining"
+        victim = out["worker"]
+        wait_until(lambda: victim not in router.workers,
+                   msg="victim never reaped")
+        before = answered[0]
+        wait_until(lambda: answered[0] >= before + 5)  # survivors serve
+        stop.set()
+        for th in herd:
+            th.join(timeout=30.0)
+        assert errors == [], errors[:3]
+        view = router.membership()
+        assert view["admitted_workers"] == 1
+        assert victim not in view["workers"]
+    finally:
+        stop.set()
+        scaler.shutdown()
+        router.close()
+        for srv in servers.values():
+            try:
+                srv.close()
+            except Exception:  # noqa: BLE001 — victim already closed
+                pass
+
+
+# -------------------------------------------------- supervisor readmit
+
+
+class _FakeProc:
+    def __init__(self, pid):
+        self.pid = pid
+        self.alive = True
+
+    def is_alive(self):
+        return self.alive
+
+    def join(self, timeout=None):
+        pass
+
+
+def _make_supervisor(clock, max_restarts=1):
+    spawned = []
+
+    def spawn(aid, inc):
+        proc = _FakeProc(pid=5000 + 100 * aid + inc)
+        spawned.append((aid, inc, proc))
+        return proc
+
+    sup = FleetSupervisor(
+        spawn, n_actors=2, liveness=lambda: {},
+        on_death=lambda aid, inc: 1,
+        heartbeat_timeout_s=3.0, max_restarts=max_restarts,
+        backoff_s=0.5, clock=clock, kill=lambda pid, sig: None,
+        rng=random.Random(0),
+    )
+    with sup._lock:
+        for aid in range(sup.n_actors):
+            sup._incarnation[aid] = 0
+            sup._restarts[aid] = 0
+            sup._procs[aid] = sup._spawn(aid, 0)
+            sup._spawned_at[aid] = clock()
+    return sup, spawned
+
+
+def _exhaust_slot(sup, spawned, clock, aid=0, rounds=2):
+    for _ in range(rounds):
+        next(
+            p for a, _i, p in reversed(spawned) if a == aid
+        ).alive = False
+        sup.poll_once()
+        clock.t += 2.0
+        sup.poll_once()
+
+
+def test_supervisor_readmit_resets_budget_and_bumps_incarnation():
+    clock = _Clock()
+    sup, spawned = _make_supervisor(clock, max_restarts=1)
+    # Nothing gave up yet: nothing to re-admit.
+    assert sup.readmit(0) is False
+    _exhaust_slot(sup, spawned, clock)
+    st = sup.stats()
+    assert st["gave_up"] == [0]
+    last_inc = st["actors"][0]["incarnation"]
+    assert sup.readmit(0) is True
+    st = sup.stats()
+    assert st["gave_up"] == []
+    assert st["actors"][0]["restarts"] == 0  # budget reset
+    # Strictly increasing incarnation: the watermark fence holds past
+    # every retired incarnation.
+    assert st["actors"][0]["incarnation"] == last_inc + 1
+    assert spawned[-1][:2] == (0, last_inc + 1)
+    assert st["actors"][0]["alive"] is True
+    # Idempotence: a live slot cannot be re-admitted twice.
+    assert sup.readmit(0) is False
+
+
+# -------------------------------------------- training elastic manager
+
+
+class _FakeSupervisor:
+    def __init__(self, n=3):
+        self.n = n
+        self.gave_up = set()
+        self.incarnation = {aid: 0 for aid in range(n)}
+        self.purged = 0
+        self.readmits = []
+        self.readmit_ok = True
+
+    def stats(self):
+        return {
+            "gave_up": sorted(self.gave_up),
+            "purged_on_death_total": self.purged,
+            "alive": self.n - len(self.gave_up),
+            "actors": {
+                aid: {"incarnation": self.incarnation[aid]}
+                for aid in range(self.n)
+            },
+        }
+
+    def readmit(self, aid):
+        self.readmits.append(aid)
+        if not self.readmit_ok:
+            return False
+        self.gave_up.discard(aid)
+        self.incarnation[aid] += 1
+        return True
+
+
+def test_training_degrade_once_then_readmit_after_penance():
+    sup = _FakeSupervisor(n=3)
+    log = DecisionLog()
+    mgr = TrainingElasticManager(
+        sup, n_actors=3, log=log, readmit_epochs=2,
+        topology=lambda: {"process_count": 1},
+    )
+    assert mgr.poll_epoch(1) == []
+    sup.gave_up.add(1)
+    sup.purged = 40
+    decisions = mgr.poll_epoch(2)
+    assert [d["action"] for d in decisions] == ["degrade"]
+    assert decisions[0]["actor_id"] == 1
+    assert decisions[0]["replicas_before"] == 3
+    assert decisions[0]["replicas_after"] == 2
+    assert decisions[0]["purged_on_death_total"] == 40
+    # Same abandoned slot next epoch: degrade is an EDGE, not a level.
+    assert mgr.poll_epoch(3) == []
+    assert sup.readmits == []  # penance (2 epochs) not yet served
+    decisions = mgr.poll_epoch(4)
+    assert [d["action"] for d in decisions] == ["readmit"]
+    assert decisions[0]["actor_id"] == 1
+    assert decisions[0]["replicas_after"] == 3
+    assert sup.readmits == [1]
+    m = mgr.metrics()
+    assert m["elastic/degraded_slots"] == 0
+    assert m["elastic/surviving"] == 3
+    assert m["elastic/degrade_total"] == 1
+    assert m["elastic/readmit_total"] == 1
+    assert m["elastic/decisions_total"] == 2
+
+
+def test_training_readmit_failure_keeps_slot_degraded():
+    sup = _FakeSupervisor(n=2)
+    sup.readmit_ok = False
+    mgr = TrainingElasticManager(
+        sup, n_actors=2, readmit_epochs=1,
+        topology=lambda: {"process_count": 1},
+    )
+    sup.gave_up.add(0)
+    mgr.poll_epoch(1)
+    assert mgr.poll_epoch(2) == []  # readmit refused: stays degraded
+    assert mgr.snapshot()["degraded"].keys() == {"0"}
+    sup.readmit_ok = True
+    decisions = mgr.poll_epoch(3)
+    assert [d["action"] for d in decisions] == ["readmit"]
+
+
+def test_training_externally_recovered_slot_is_dropped_silently():
+    sup = _FakeSupervisor(n=2)
+    mgr = TrainingElasticManager(
+        sup, n_actors=2, readmit_epochs=5,
+        topology=lambda: {"process_count": 1},
+    )
+    sup.gave_up.add(0)
+    mgr.poll_epoch(1)
+    sup.gave_up.discard(0)  # operator readmitted out-of-band
+    assert mgr.poll_epoch(2) == []
+    assert mgr.metrics()["elastic/degraded_slots"] == 0
+    assert sup.readmits == []
+
+
+def test_training_snapshot_restore_carries_degraded_topology():
+    """A learner that checkpoints degraded resumes degraded: the
+    readmission clock continues from the checkpoint, and the topology
+    stamp rides along."""
+    sup = _FakeSupervisor(n=3)
+    mgr = TrainingElasticManager(
+        sup, n_actors=3, readmit_epochs=3,
+        topology=lambda: {"process_count": 2, "process_index": 0},
+    )
+    sup.gave_up.add(2)
+    mgr.poll_epoch(5)
+    snap = mgr.snapshot()
+    assert snap["surviving"] == 2
+    assert snap["degraded"]["2"]["epoch"] == 5
+    assert snap["topology"]["process_count"] == 2
+    # Fresh manager (post-resume), same supervisor state.
+    mgr2 = TrainingElasticManager(
+        sup, n_actors=3, readmit_epochs=3,
+        topology=lambda: {"process_count": 2, "process_index": 0},
+    )
+    mgr2.restore(snap)
+    assert mgr2.metrics()["elastic/degraded_slots"] == 1
+    # Epoch 7: only 2 degraded epochs served — no readmit, and no
+    # SECOND degrade decision for the restored slot either.
+    assert mgr2.poll_epoch(7) == []
+    decisions = mgr2.poll_epoch(8)  # 3 served: readmit
+    assert [d["action"] for d in decisions] == ["readmit"]
+    assert TrainingElasticManager(
+        sup, n_actors=3, topology=lambda: {},
+    ).restore(None) is None  # empty restore is a no-op
+    with pytest.raises(ValueError, match="readmit_epochs"):
+        TrainingElasticManager(sup, n_actors=3, readmit_epochs=0)
+
+
+# -------------------------------------------------- topology helpers
+
+
+def test_topology_snapshot_and_degraded_resume_plan():
+    topo = topology_snapshot()
+    assert topo["process_count"] >= 1
+    assert topo["local_device_count"] >= 1
+    plan = plan_degraded_resume(
+        {"process_count": 4}, {"process_count": 2}
+    )
+    assert plan["degraded"] is True
+    assert plan["restored"] is False
+    assert plan["reshard"] is True
+    assert plan["surviving_fraction"] == 0.5
+    plan = plan_degraded_resume(
+        {"process_count": 2}, {"process_count": 4}
+    )
+    assert plan["restored"] is True and plan["degraded"] is False
+    plan = plan_degraded_resume(
+        {"process_count": 2}, {"process_count": 2}
+    )
+    assert plan["reshard"] is False
+    # No stamp in the checkpoint (pre-elastic run): plain resume.
+    plan = plan_degraded_resume(None, {"process_count": 2})
+    assert plan["reshard"] is False
+
+
+# ------------------------------------------------------ off-parity pins
+
+
+def test_elastic_off_is_the_default_and_validated():
+    assert SACConfig().elastic == "off"
+    with pytest.raises(ValueError, match="elastic"):
+        SACConfig(elastic="sometimes")
+    with pytest.raises(ValueError, match="actors"):
+        SACConfig(elastic="on", actors=0)
+    with pytest.raises(ValueError, match="elastic_readmit_epochs"):
+        SACConfig(elastic="on", actors=1, elastic_readmit_epochs=0)
+    SACConfig(elastic="on", actors=1)  # valid combination
+
+
+def test_router_metrics_have_no_fleet_key_unless_extra_attached():
+    """The /metrics key pin: without a warm pool or elastic controller
+    fleet_extra stays None and the aggregate has no 'fleet' section;
+    attaching it adds exactly that section."""
+    from torch_actor_critic_tpu.serve import FleetRouter as RealRouter
+
+    # One never-polled dummy worker: the router needs a member, the
+    # pin only concerns the aggregate's key set. start() before close()
+    # — HTTPServer.shutdown() blocks unless serve_forever is running.
+    router = RealRouter(["http://127.0.0.1:1"], poll_interval_s=30.0).start()
+    try:
+        agg = router.aggregate_metrics()
+        assert "fleet" not in agg
+        router.fleet_extra = lambda: {"warm_pool": {"ready": 1}}
+        agg = router.aggregate_metrics()
+        assert agg["fleet"] == {"warm_pool": {"ready": 1}}
+        # A faulting extra is logged, never a /metrics 500.
+        router.fleet_extra = lambda: 1 / 0
+        agg = router.aggregate_metrics()
+        assert "fleet" not in agg
+    finally:
+        router.close()
+
+
+def test_collector_window_hook_default_none_and_fault_contained():
+    from torch_actor_critic_tpu.obs import ObsCollector
+
+    col = ObsCollector(interval_s=60.0, port=0)
+    try:
+        assert col.window_hook is None  # the --elastic off contract
+        col.add_source("learner", lambda: {"metrics": {"x": 1.0}})
+        col.scrape_once()
+        rows = []
+        col.window_hook = rows.append
+        row = col.scrape_once()
+        assert rows and rows[0] is row
+        assert "slo" in rows[0] and "merged" in rows[0]
+        # A hook that raises is contained: the scrape series continues.
+        col.window_hook = lambda row: 1 / 0
+        col.scrape_once()
+        assert col.scrapes_total == 3
+    finally:
+        col.close()

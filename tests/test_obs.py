@@ -102,6 +102,71 @@ def test_merge_restarted_worker_never_double_counts():
     assert after["requests_total"] == 107
 
 
+def test_merge_flapping_source_never_double_counts_or_goes_negative():
+    """A source that disappears and REAPPEARS between scrapes (flap,
+    not just one dead window): each scrape's fold is exactly the sum
+    over that scrape's live snapshots — no stale contribution rides
+    along on the re-join, and no total ever goes negative."""
+    series = [
+        {"a": {"requests_total": 100}, "b": {"requests_total": 50}},
+        {"a": {"requests_total": 104}, "b": None},  # b flaps away
+        {"a": {"requests_total": 110},
+         "b": {"requests_total": 52}},              # b re-joins
+        {"a": {"requests_total": 115}},             # b removed outright
+        {"a": {"requests_total": 120},
+         "b": {"requests_total": 3}},               # re-added, counters reset
+    ]
+    totals = []
+    for snaps in series:
+        agg = aggregate_snapshots(snaps)
+        live_sum = sum(
+            s["requests_total"] for s in snaps.values()
+            if s is not None and "requests_total" in s
+        )
+        assert agg["requests_total"] == live_sum
+        assert agg["requests_total"] >= 0
+        totals.append(agg["requests_total"])
+    assert totals == [150, 104, 162, 115, 123]
+    # The dip window labels the flapper instead of hiding it.
+    dip = aggregate_snapshots(series[1])
+    assert dip["sources"]["b"] == {"unreachable": True}
+    assert dip["sources_reporting"] == 1
+
+
+def test_merge_flapping_key_absent_variant_contributes_zero():
+    """The key-absent flap (a live source whose snapshot lost the
+    counter — a worker mid-restart serving partial /metrics)
+    contributes zero for that key: never a KeyError, never negative."""
+    agg = aggregate_snapshots({"a": {"requests_total": 9}, "b": {}})
+    assert agg["requests_total"] == 9
+    assert agg["sources_reporting"] == 2  # b IS reporting, just empty
+    # Dynamic mode discovers each key from whoever carries it.
+    agg = aggregate_snapshots({"a": {"x_total": 4}, "b": {"y_total": 2}})
+    assert agg["x_total"] == 4 and agg["y_total"] == 2
+
+
+def test_slo_delta_mode_never_double_counts_across_a_flap():
+    """A delta-mode rate rule over the MERGED counter, with a source
+    flapping away and back: the dip is a negative delta (never a max
+    breach) and the re-join delta is exactly the live-sum difference.
+    If the fold double-counted a reappearing source (stale + live),
+    the re-join window would spuriously breach this threshold."""
+    rule = SLORule("sheds", "merged.sheds_total", "max", 100.0,
+                   mode="delta", breach_windows=1)
+    eng = SLOEngine([rule], clock=lambda: 0.0)
+    series = [
+        {"a": {"sheds_total": 100}, "b": {"sheds_total": 50}},  # 150
+        {"a": {"sheds_total": 110}, "b": {"sheds_total": 55}},  # 165: arms
+        {"a": {"sheds_total": 120}, "b": None},                 # 120: dip
+        {"a": {"sheds_total": 130}, "b": {"sheds_total": 58}},  # 188: +68
+        {"a": {"sheds_total": 140}, "b": {"sheds_total": 60}},  # 200: +12
+    ]
+    for snaps in series:
+        events = eng.observe({"merged": aggregate_snapshots(snaps)})
+        assert events == [], (snaps, events)
+    assert eng.snapshot()["breaches_total"] == 0
+
+
 def test_merge_hist_spec_mismatch_recorded_never_raised():
     bad = {"requests_total": 1, "latency_hist": {"counts": "garbage"}}
     agg = aggregate_snapshots({"w0": _snap(0), "w1": bad})
@@ -242,6 +307,47 @@ def test_slo_load_rules_grammar_errors_are_loud(tmp_path):
         load_rules(str(tmp_path / "missing.json"))
 
 
+def test_slo_load_rules_errors_name_rule_and_list_grammar(tmp_path):
+    """Every --slo-config grammar error names the offending rule (by
+    name when it has one, by position otherwise) and lists the valid
+    keys and comparators — a typo'd config tells you how to fix it."""
+    def write(obj):
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps(obj))
+        return str(p)
+
+    with pytest.raises(ValueError) as ei:
+        load_rules(write([
+            {"name": "ok", "path": "a", "op": "min", "threshold": 1},
+            {"name": "bad", "path": "a", "op": "min", "threshold": 1,
+             "thresold": 2},
+        ]))
+    msg = str(ei.value)
+    assert "rule 1 ('bad')" in msg          # names the offender
+    assert "'thresold'" in msg              # names the bad key
+    assert "valid keys" in msg and "'threshold'" in msg
+    assert "comparators (op): ['min', 'max']" in msg
+
+    with pytest.raises(ValueError) as ei:
+        load_rules(write([{"op": "min", "threshold": 1}]))
+    msg = str(ei.value)
+    assert "rule 0" in msg and "missing" in msg
+    assert "'name', 'path'" in msg
+
+    with pytest.raises(ValueError) as ei:
+        load_rules(write([{"name": "r", "path": "a", "op": "between",
+                           "threshold": 1}]))
+    msg = str(ei.value)
+    assert "rule 0 ('r')" in msg and "op must be" in msg
+    assert "valid keys" in msg
+
+    with pytest.raises(ValueError, match="wrong-typed"):
+        load_rules(write([{"name": "r", "path": "a", "op": "min",
+                           "threshold": {"no": 1}}]))
+    with pytest.raises(ValueError, match="rule 0 is not an object"):
+        load_rules(write(["not-an-object"]))
+
+
 def test_slo_report_and_defaults():
     rules = default_rules()
     assert len({r.name for r in rules}) == len(rules)
@@ -339,6 +445,31 @@ def test_collector_http_source_extra_paths_nest_under_name():
         scrape = http_source(col.address, ("/metrics", "/healthz"))
         body = scrape()
         assert body["healthz"]["ok"] is True
+    finally:
+        col.close()
+
+
+def test_collector_remove_source_stops_scraping_and_readd_is_fresh():
+    """Elastic scale-in removes the drained worker's scrape source: it
+    leaves the fold entirely (no permanent counted failure), and a
+    later re-add (scale-out reusing the name) starts a fresh stats
+    row — the flap never double-counts."""
+    col = ObsCollector(interval_s=60.0)
+    try:
+        col.add_source("a", lambda: {"requests_total": 5})
+        col.add_source("w1", lambda: {"requests_total": 7})
+        row = col.scrape_once()
+        assert row["merged"]["requests_total"] == 12
+        col.remove_source("w1")
+        assert col.source_names() == ("a",)
+        row = col.scrape_once()
+        assert row["merged"]["requests_total"] == 5
+        assert "w1" not in row["sources"]
+        col.add_source("w1", lambda: {"requests_total": 1})
+        row = col.scrape_once()
+        assert row["merged"]["requests_total"] == 6
+        assert row["sources"]["w1"]["scrapes"] == 1  # fresh stats row
+        col.remove_source("nope")  # unknown: a no-op, never a raise
     finally:
         col.close()
 

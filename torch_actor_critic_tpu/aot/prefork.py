@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import typing as t
 
 logger = logging.getLogger(__name__)
@@ -65,6 +66,13 @@ class WarmPool:
         self.spawned = 0  # guarded-by: _cv
         self.drawn = 0  # guarded-by: _cv
         self.spawn_failures = 0  # guarded-by: _cv
+        # Last refill attempt, for the router's fleet /metrics section:
+        # did it succeed, when (monotonic), and the failure detail if
+        # not — so "the pool is quietly broken" is visible to the
+        # elastic controller's operators, not just this process's log.
+        self.last_refill_ok: bool | None = None  # guarded-by: _cv
+        self.last_refill_at: float | None = None  # guarded-by: _cv
+        self.last_refill_error: str | None = None  # guarded-by: _cv
         self._thread: threading.Thread | None = None
         if self.size > 0:
             self._thread = threading.Thread(
@@ -85,10 +93,15 @@ class WarmPool:
             # draw() must stay responsive for already-ready spares.
             try:
                 handle, address = self._spawn()
-            except Exception:  # noqa: BLE001 — launcher owns the detail
+            except Exception as e:  # noqa: BLE001 — launcher owns the detail
                 logger.exception("%s: spare worker spawn failed", self.name)
                 with self._cv:
                     self.spawn_failures += 1
+                    self.last_refill_ok = False
+                    self.last_refill_at = time.monotonic()
+                    self.last_refill_error = (
+                        f"{type(e).__name__}: {e}"[:200]
+                    )
                     if self._stopped:
                         return
                 # Plain sleep (not cv.wait): back off even when draws
@@ -100,6 +113,9 @@ class WarmPool:
                     break
                 self._spares.append(WarmWorker(handle, address))
                 self.spawned += 1
+                self.last_refill_ok = True
+                self.last_refill_at = time.monotonic()
+                self.last_refill_error = None
                 self._cv.notify_all()
         # Stopped mid-spawn: the fresh worker is ours to reap.
         try:
@@ -129,14 +145,22 @@ class WarmPool:
 
     def stats(self) -> dict:
         """Pool counters for /metrics: ready spares, lifetime spawns /
-        draws / spawn failures."""
+        draws / spawn failures, and the last refill attempt's status
+        (ok flag, age in seconds, error detail if it failed)."""
         with self._cv:
+            age = (
+                None if self.last_refill_at is None
+                else round(time.monotonic() - self.last_refill_at, 3)
+            )
             return {
                 "size": self.size,
                 "ready": len(self._spares),
                 "spawned": self.spawned,
                 "drawn": self.drawn,
                 "spawn_failures": self.spawn_failures,
+                "last_refill_ok": self.last_refill_ok,
+                "last_refill_age_s": age,
+                "last_refill_error": self.last_refill_error,
             }
 
     # ---------------------------------------------------------- shutdown

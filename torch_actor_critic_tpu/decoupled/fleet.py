@@ -438,6 +438,31 @@ class FleetSupervisor:
             aid, inc, getattr(proc, "pid", "?"), self._restarts[aid],
         )
 
+    def readmit(self, aid: int) -> bool:
+        """Elastic re-admission of an abandoned slot (docs/RESILIENCE.md
+        "Elasticity"): clear the exhausted restart budget and respawn
+        the slot at the next incarnation — the watermark fence still
+        holds because the incarnation strictly increases past every
+        retired one. Called at epoch boundaries by the
+        TrainingElasticManager; returns False for a slot that never
+        gave up (nothing to re-admit)."""
+        with self._lock:
+            if aid not in self._gave_up:
+                return False
+            self._gave_up.discard(aid)
+            self._restarts[aid] = 0
+            self._respawn_at.pop(aid, None)
+            inc = self._incarnation.get(aid, 0) + 1
+            self._incarnation[aid] = inc
+            proc = self._spawn(aid, inc)
+            self._procs[aid] = proc
+            self._spawned_at[aid] = self._clock()
+        logger.info(
+            "re-admitted actor %d as incarnation %d (pid %s); restart "
+            "budget reset", aid, inc, getattr(proc, "pid", "?"),
+        )
+        return True
+
     def shutdown(self, term_timeout_s: float = 10.0) -> None:
         """Roll the fleet down: stop supervising, SIGTERM every live
         actor (graceful stop -> flush), join, SIGKILL stragglers."""
@@ -542,6 +567,23 @@ class FleetTrainer(DecoupledTrainer):
         )
         self._restored_incarnations: t.Dict[int, int] = {}
         self._fleet_started = False
+        # Elastic degrade/re-admit (docs/RESILIENCE.md "Elasticity").
+        # Off (the default) constructs nothing: no decision log, no
+        # elastic/ metric keys — the key-pin contract matches the
+        # obs-off one.
+        self.elastic = None
+        if cfg.elastic == "on":
+            from torch_actor_critic_tpu.elastic import (
+                DecisionLog,
+                TrainingElasticManager,
+            )
+
+            self.elastic = TrainingElasticManager(
+                supervisor=self.supervisor,
+                n_actors=cfg.actors,
+                log=DecisionLog(telemetry=self.telemetry),
+                readmit_epochs=cfg.elastic_readmit_epochs,
+            )
         # Run-wide obs plane: the collector (built in Trainer.__init__,
         # started at train() entry) scrapes the transport's /metrics +
         # /healthz — staging conservation and per-actor liveness land
@@ -675,6 +717,14 @@ class FleetTrainer(DecoupledTrainer):
             ))
         if self._trace_dir is not None:
             events.extend(actor_span_events(self._trace_dir))
+        if self.elastic is not None:
+            from torch_actor_critic_tpu.telemetry.traceview import (
+                elastic_decision_events,
+            )
+
+            events.extend(elastic_decision_events(
+                self.elastic.log.records()
+            ))
         return events
 
     # --------------------------------------------------------- checkpoint
@@ -698,6 +748,11 @@ class FleetTrainer(DecoupledTrainer):
             self.transport.watermarks()
         )
         extra["decoupled"]["fleet"] = self.supervisor.stats()
+        if self.elastic is not None:
+            # Degraded topology rides the checkpoint: a learner that
+            # saved with slots degraded resumes degraded and re-admits
+            # on its own epoch schedule.
+            extra["decoupled"]["elastic"] = self.elastic.snapshot()
         return extra
 
     def _restore_extras(self, meta: dict, arrays) -> None:
@@ -712,6 +767,8 @@ class FleetTrainer(DecoupledTrainer):
             for aid, m in marks.items()
         }
         self.supervisor.load_stats(dec.get("fleet") or {})
+        if self.elastic is not None:
+            self.elastic.restore(dec.get("elastic"))
         if marks:
             logger.info(
                 "restored transport watermarks for %d actors; "
@@ -750,6 +807,12 @@ class FleetTrainer(DecoupledTrainer):
             last_metrics[f"decoupled/actor{aid}_heartbeat_age_s"] = (
                 round(float(a["heartbeat_age_s"]), 3)
             )
+        if self.elastic is not None:
+            # Degrade newly abandoned slots, re-admit served ones —
+            # the training-plane actuation point (epoch boundaries
+            # only, so a re-admitted slice joins at a clean cut).
+            self.elastic.poll_epoch(int(epoch))
+            last_metrics.update(self.elastic.metrics())
         if rec is not None:
             rec.event(
                 "fleet", epoch=int(epoch), transport=tsnap,
@@ -762,6 +825,8 @@ class FleetTrainer(DecoupledTrainer):
         snap = super().metrics_snapshot()
         snap["decoupled"]["transport"] = self.transport.snapshot()
         snap["decoupled"]["fleet"] = self.supervisor.stats()
+        if self.elastic is not None:
+            snap["decoupled"]["elastic"] = self.elastic.snapshot()
         return snap
 
     def close(self):

@@ -1,0 +1,27 @@
+"""Elastic self-healing fleet: the actuator over the PR-19 obs plane.
+
+``serve.py --elastic on`` scales the serving fleet with load
+(controller + FleetScaler); ``train.py --elastic on`` degrades to the
+surviving actor slice on host loss and re-admits it at an epoch
+boundary (TrainingElasticManager). Off (the default) constructs
+nothing — no threads, no sockets, no metric keys.
+Runbook: docs/RESILIENCE.md "Elasticity".
+"""
+
+from torch_actor_critic_tpu.elastic.controller import (
+    DECISION_FIELDS,
+    DecisionLog,
+    ElasticController,
+    ElasticPolicy,
+)
+from torch_actor_critic_tpu.elastic.serving import FleetScaler
+from torch_actor_critic_tpu.elastic.training import TrainingElasticManager
+
+__all__ = [
+    "DECISION_FIELDS",
+    "DecisionLog",
+    "ElasticController",
+    "ElasticPolicy",
+    "FleetScaler",
+    "TrainingElasticManager",
+]

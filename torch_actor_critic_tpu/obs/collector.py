@@ -111,6 +111,10 @@ class ObsCollector:
         self._last_row: t.Optional[dict] = None  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: t.Optional[threading.Thread] = None  # guarded-by: _lock
+        # Per-window subscriber (the elastic controller's
+        # observe_window). None by default: with no hook attached the
+        # pointer check is the whole cost — the --elastic off contract.
+        self.window_hook: t.Optional[t.Callable[[dict], t.Any]] = None
         self.port = 0
         self._server: t.Optional[ThreadingHTTPServer] = self._build_server(
             port
@@ -130,6 +134,17 @@ class ObsCollector:
                 "scrapes": 0, "failures": 0, "live": False,
                 "last_error": None, "last_scrape_ms": 0.0,
             })
+
+    def remove_source(self, name: str) -> None:
+        """Forget a plane (elastic scale-in: a drained worker stops
+        being scraped instead of turning into a permanent counted
+        failure). Its stats row is dropped too — source flapping is
+        covered by the merge-layer tests: totals over the survivors
+        never go negative and a re-added source re-enters the sum
+        fresh."""
+        with self._lock:
+            self._sources.pop(name, None)
+            self._stats.pop(name, None)
 
     def source_names(self) -> t.Tuple[str, ...]:
         with self._lock:
@@ -201,6 +216,12 @@ class ObsCollector:
             self._last_row = row
         if self.sink is not None:
             self.sink.write(row)
+        hook = self.window_hook
+        if hook is not None:
+            try:
+                hook(row)
+            except Exception:  # noqa: BLE001 - a bad subscriber must not break the scrape series
+                logger.exception("obs window hook failed")
         return row
 
     def _source_stats(self) -> dict:

@@ -109,11 +109,26 @@ _RULE_KEYS = frozenset(
      "recover_windows", "mode", "missing_ok")
 )
 
+# Appended to every grammar error so a typo'd config tells the operator
+# the whole vocabulary, not just what broke.
+_GRAMMAR_HINT = (
+    f"valid keys: {sorted(_RULE_KEYS)}; comparators (op): "
+    f"{list(_OPS)}; modes: {list(_MODES)}"
+)
+
+
+def _rule_label(i: int, spec: t.Any) -> str:
+    """Name the offending rule in errors: its 'name' when it has one,
+    its position otherwise."""
+    name = spec.get("name") if isinstance(spec, dict) else None
+    return f"rule {i} ({name!r})" if name else f"rule {i}"
+
 
 def load_rules(path: str) -> t.List[SLORule]:
     """Parse an ``--slo-config`` JSON file. Grammar errors are
     ``ValueError`` at startup — a malformed SLO config should fail the
-    run before it silently monitors nothing."""
+    run before it silently monitors nothing — and every one names the
+    offending rule and lists the valid keys/comparators."""
     try:
         with open(path) as f:
             raw = json.load(f)
@@ -126,24 +141,47 @@ def load_rules(path: str) -> t.List[SLORule]:
         )
     rules = []
     for i, spec in enumerate(raw):
+        label = _rule_label(i, spec)
         if not isinstance(spec, dict):
             raise ValueError(
-                f"SLO config {path}: rule {i} is not an object"
+                f"SLO config {path}: {label} is not an object; "
+                f"{_GRAMMAR_HINT}"
             )
         unknown = set(spec) - _RULE_KEYS
         if unknown:
             raise ValueError(
-                f"SLO config {path}: rule {i} has unknown keys "
-                f"{sorted(unknown)}"
+                f"SLO config {path}: {label} has unknown keys "
+                f"{sorted(unknown)}; {_GRAMMAR_HINT}"
             )
+        missing = [k for k in ("name", "path") if not spec.get(k)]
         if "threshold" not in spec:
+            missing.append("threshold")
+        if missing:
             raise ValueError(
-                f"SLO config {path}: rule {i} is missing 'threshold'"
+                f"SLO config {path}: {label} is missing "
+                f"{', '.join(repr(k) for k in missing)}; "
+                f"{_GRAMMAR_HINT}"
             )
-        rules.append(SLORule(**spec))
+        try:
+            rules.append(SLORule(**spec))
+        except ValueError as e:
+            raise ValueError(
+                f"SLO config {path}: {label}: {e}; {_GRAMMAR_HINT}"
+            ) from e
+        except TypeError as e:
+            # Wrong-typed values (a dict threshold, a list for an int
+            # field): float()/int() raise TypeError — surface it as
+            # the same startup ValueError the rest of the grammar uses.
+            raise ValueError(
+                f"SLO config {path}: {label} has a wrong-typed value "
+                f"({e}); {_GRAMMAR_HINT}"
+            ) from e
     names = [r.name for r in rules]
-    if len(set(names)) != len(names):
-        raise ValueError(f"SLO config {path}: duplicate rule names")
+    dupes = sorted({n for n in names if names.count(n) > 1})
+    if dupes:
+        raise ValueError(
+            f"SLO config {path}: duplicate rule names {dupes}"
+        )
     return rules
 
 

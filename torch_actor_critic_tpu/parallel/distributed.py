@@ -156,3 +156,48 @@ def process_info() -> t.Tuple[int, int]:
     """(process_index, process_count) — ref ``proc_id``/``num_procs``
     (``sac/mpi.py:37-43``)."""
     return jax.process_index(), jax.process_count()
+
+
+def topology_snapshot() -> t.Dict[str, int]:
+    """The process/device topology this run is executing under — the
+    stamp elastic checkpoints carry (docs/RESILIENCE.md "Elasticity":
+    degraded-topology semantics). Under multi-process
+    ``jax.distributed`` the ``process_count`` IS the dp host-slice
+    count; single-host runs stamp ``1``."""
+    return {
+        "process_count": int(jax.process_count()),
+        "process_index": int(jax.process_index()),
+        "local_device_count": int(jax.local_device_count()),
+        "global_device_count": int(jax.device_count()),
+    }
+
+
+def plan_degraded_resume(
+    saved: t.Mapping[str, t.Any] | None,
+    live: t.Mapping[str, t.Any] | None = None,
+) -> t.Dict[str, t.Any]:
+    """Compare a checkpoint's topology stamp against the live one and
+    say what a degraded resume must do.
+
+    A host slice lost between save and resume shows up as a smaller
+    live ``process_count``; training degrades to the surviving slice,
+    which means the per-host dp replay shards must be re-split
+    (``reshard`` True → feed the restored buffer through
+    :func:`~torch_actor_critic_tpu.parallel.elastic.reshard_buffer`
+    at the surviving device count). A slice re-admitted later (live >
+    saved) reshards the other way. Identical topology is a plain
+    resume."""
+    saved = dict(saved or {})
+    live = dict(live if live is not None else topology_snapshot())
+    saved_hosts = int(saved.get("process_count", live["process_count"]))
+    live_hosts = int(live["process_count"])
+    return {
+        "saved_hosts": saved_hosts,
+        "live_hosts": live_hosts,
+        "degraded": live_hosts < saved_hosts,
+        "restored": live_hosts > saved_hosts,
+        "reshard": live_hosts != saved_hosts,
+        "surviving_fraction": (
+            live_hosts / saved_hosts if saved_hosts else 1.0
+        ),
+    }

@@ -131,6 +131,11 @@ class FleetRouter:
         self.routed_total = 0  # guarded-by: _lock
         self.failovers_total = 0  # guarded-by: _lock
         self.no_worker_total = 0  # guarded-by: _lock
+        # Injectable extra /metrics section: serve.py points this at
+        # the warm pool + elastic controller so their counters ride
+        # the fleet-aggregated payload under ``fleet``. None (the
+        # default) adds no key — the --elastic off key-pin contract.
+        self.fleet_extra: t.Callable[[], dict] | None = None
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -271,6 +276,39 @@ class FleetRouter:
         logger.info("router: worker %s added at %s", name, url)
         self._poll_worker(w)
         return name
+
+    def drain_worker(self, name: str) -> str | None:
+        """Hold a worker out of rotation for an elastic scale-in: eject
+        with ``admin_hold`` set so the poll thread cannot re-admit it
+        while it drains. New traffic routes elsewhere immediately;
+        requests the worker already accepted finish there (its own
+        PR-5 graceful drain answers them once it is SIGTERMed — the
+        caller's next step). Returns the worker URL, or None for an
+        unknown name."""
+        with self._lock:
+            w = self.workers.get(name)
+            if w is None:
+                return None
+            w.admin_hold = True
+            self._set_admitted(w, False, "scale_in")
+            return w.url
+
+    def remove_worker(self, name: str) -> None:
+        """Forget a worker after its drain completed (elastic scale-in
+        teardown). Only a held-out or ejected worker may be removed —
+        removing an admitted one would drop routed requests, which the
+        drain path exists to prevent."""
+        with self._lock:
+            w = self.workers.get(name)
+            if w is None:
+                raise KeyError(f"no worker named {name!r}")
+            if w.admitted and not w.admin_hold:
+                raise ValueError(
+                    f"worker {name} is still admitted; drain_worker() "
+                    "it first"
+                )
+            del self.workers[name]
+        logger.info("router: worker %s removed", name)
 
     def membership(self) -> dict:
         with self._lock:
@@ -421,7 +459,10 @@ class FleetRouter:
         by :func:`aggregate_snapshots` (sums for counters, merged
         latency buckets — a restarted worker's reset counters simply
         re-enter the sum, never double-counted), plus the router's own
-        membership/routing counters under ``router``."""
+        membership/routing counters under ``router`` and, when
+        ``fleet_extra`` is attached, the warm-pool/elastic section
+        under ``fleet`` (spare count, last-refill status, controller
+        counters — docs/SERVING.md "Fleet")."""
         snaps = {
             w.name: self._fetch_worker_metrics(w)
             for w in list(self.workers.values())
@@ -430,6 +471,12 @@ class FleetRouter:
         with self._lock:
             no_worker = self.no_worker_total
         out["router"] = dict(self.membership(), no_worker_total=no_worker)
+        extra = self.fleet_extra
+        if extra is not None:
+            try:
+                out["fleet"] = extra()
+            except Exception:  # noqa: BLE001 - metrics must not fail on a torn-down pool
+                logger.exception("fleet extra metrics section failed")
         return out
 
     # ------------------------------------------------------ rolling reload

@@ -42,6 +42,7 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "RequestSpanLog",
     "compile_events",
+    "elastic_decision_events",
     "export_trace",
     "router_hop_events",
     "serve_request_events",
@@ -58,6 +59,7 @@ SERVE_PID = 2
 XLA_PID = 3
 ROUTER_PID = 4
 TRANSPORT_PID = 5
+ELASTIC_PID = 6
 ACTOR_PID_BASE = 100
 
 _ANCHOR: t.Tuple[float, float] | None = None
@@ -272,6 +274,39 @@ def staging_span_events(
     return events
 
 
+def elastic_decision_events(
+    records: t.Iterable[dict], pid: int = ELASTIC_PID
+) -> t.List[dict]:
+    """Elastic :class:`~torch_actor_critic_tpu.elastic.controller.
+    DecisionLog` records -> trace events on the elastic lane.
+
+    Each decision (``scale_out``/``scale_in``/``degrade``/``readmit``)
+    renders as one span named ``elastic <action>`` whose args carry
+    the schema fields (rule, reason, replicas before/after, outcome),
+    so a spawn sits on the same timeline as the breach that caused it
+    and the drain that later reversed it. Serving decisions land on
+    tid 0, training decisions on tid 1 — two sub-lanes of one elastic
+    process lane."""
+    events: t.List[dict] = []
+    for rec in records:
+        t0 = rec.get("t0")
+        if t0 is None:
+            continue
+        args = {
+            k: rec[k]
+            for k in ("seq", "plane", "action", "reason", "rule",
+                      "replicas_before", "replicas_after", "outcome",
+                      "worker", "actor_id", "epoch")
+            if rec.get(k) is not None
+        }
+        events.extend(span_event(
+            f"elastic {rec.get('action', '?')}", perf_to_us(float(t0)),
+            float(rec.get("dur_s", 0.0)) * 1e6, pid,
+            1 if rec.get("plane") == "train" else 0, args=args,
+        ))
+    return events
+
+
 def compile_events(records: t.Iterable[dict]) -> t.List[dict]:
     """Watchdog compile records (``{source, time, duration_s}``, wall
     clock) -> trace events on the XLA pid. The monitoring event fires
@@ -294,6 +329,7 @@ def _metadata_events(extra_pids: t.Iterable[int] = ()) -> t.List[dict]:
     named = {
         TRAIN_PID: "train", SERVE_PID: "serve", XLA_PID: "xla-compile",
         ROUTER_PID: "router", TRANSPORT_PID: "staging-transport",
+        ELASTIC_PID: "elastic",
     }
     rows = list(named.items())
     for pid in sorted(set(extra_pids) - set(named)):
@@ -342,6 +378,7 @@ def export_trace(path: str | os.PathLike, *event_lists: t.List[dict]) -> dict:
         "compile_spans": by_pid.get(XLA_PID, 0),
         "router_spans": by_pid.get(ROUTER_PID, 0),
         "transport_spans": by_pid.get(TRANSPORT_PID, 0),
+        "elastic_spans": by_pid.get(ELASTIC_PID, 0),
         "actor_spans": sum(
             n for p, n in by_pid.items() if p >= ACTOR_PID_BASE
         ),

@@ -425,6 +425,18 @@ class SACConfig:
     # off, the append-only one-file-per-run default). Rotation keeps
     # one `.1` generation and writes a counted `sink_rotated` marker.
     telemetry_max_mb: float = 0.0
+    # Training-plane elasticity (elastic/, docs/RESILIENCE.md
+    # "Elasticity"): with `--elastic on`, an actor slot that exhausts
+    # its restart budget becomes a counted `degrade` decision (the run
+    # trains on the surviving slice; the conservation ledger's
+    # dropped_dead_actor term absorbs the lost slice), and the slot is
+    # re-admitted with a reset budget after `elastic_readmit_epochs`
+    # degraded epochs — at an epoch boundary, so the slice rejoins at
+    # a clean cut. Checkpoints carry the degraded topology. Off (the
+    # default) constructs nothing: no decision log, no elastic/ metric
+    # keys (key-pin, tests/test_elastic_controller.py).
+    elastic: str = "off"
+    elastic_readmit_epochs: int = 1
 
     def __post_init__(self):
         if not (len(self.filters) == len(self.kernel_sizes) == len(self.strides)):
@@ -610,6 +622,20 @@ class SACConfig:
                     f"obs_scrape entries must be name=url pairs, got "
                     f"{pair!r}"
                 )
+        if self.elastic not in ("off", "on"):
+            raise ValueError(
+                f"elastic must be 'off' or 'on', got {self.elastic!r}"
+            )
+        if self.elastic == "on" and self.actors < 1:
+            raise ValueError(
+                "elastic is the fleet degrade/re-admit machinery; it "
+                "needs an actor fleet (--actors >= 1)"
+            )
+        if self.elastic_readmit_epochs < 1:
+            raise ValueError(
+                f"elastic_readmit_epochs must be >= 1, got "
+                f"{self.elastic_readmit_epochs}"
+            )
         if self.decoupled:
             if self.on_device:
                 raise ValueError(
