@@ -517,10 +517,25 @@ def run_fleet(args, argv):
             )
 
             decision_log = DecisionLog()
+
+            def _on_drain_select(name, proc):
+                # Scale-in victim: disown it from the warm-pool
+                # monitor's tracking BEFORE it is SIGTERMed, so its
+                # post-drain exit never reads as a crash the monitor
+                # would "replace" from the pool (a drain->replace flap
+                # that burns spares and negates the scale-in).
+                worker_names.pop(id(proc), None)
+                with worker_lock:
+                    try:
+                        workers.remove(proc)
+                    except ValueError:
+                        pass  # elastic-spawned: never monitor-tracked
+
             scaler = FleetScaler(
                 router, pool, obs=obs,
                 drain_exit_timeout_s=args.drain_timeout + 30,
                 obs_source=http_source,
+                on_drain_select=_on_drain_select,
             )
             for i, (proc, addr) in enumerate(zip(workers, addresses)):
                 worker_names[id(proc)] = f"w{i}"

@@ -151,11 +151,63 @@ def test_scale_out_holds_at_max_replicas_counted_not_actuated():
     assert ctl.observe_window(_window(_breach("p99_ceiling"))) == []
     assert act.out_calls == []
     assert ctl.snapshot()["bounded_total"] == 1
-    # The hold consumed the rule's cooldown too: the NEXT window does
-    # not retry until it elapses (no per-window warning spam).
+    # The hold consumed the retry backoff: the NEXT window does not
+    # retry until it elapses (no per-window warning spam).
     clock.t += 1.0
     assert ctl.observe_window(_window()) == []
     assert ctl.snapshot()["bounded_total"] == 1
+    # A replica dying right after the hold is picked up at the retry
+    # backoff, NOT silenced for the full 10s cooldown the bounded
+    # attempt never earned.
+    act._replicas = 3
+    clock.t += 1.5  # now 2.5s past the hold: backoff (2s) elapsed
+    decisions = ctl.observe_window(_window())
+    assert [d["action"] for d in decisions] == ["scale_out"]
+    assert act.replicas() == 4
+
+
+def test_no_spare_scale_out_retries_after_backoff_not_full_cooldown():
+    """A draw that found no warm spare added no capacity, so the rule
+    must not be silenced for the full cooldown — it retries at the
+    short backoff and spawns the moment a spare is ready."""
+
+    class _EmptyPoolActuator(_FakeActuator):
+        def __init__(self):
+            super().__init__(replicas=1)
+            self.spare_ready = False
+
+        def scale_out(self, reason=""):
+            self.out_calls.append(reason)
+            if not self.spare_ready:
+                return {"outcome": "no_spare"}
+            self._replicas += 1
+            return {"outcome": "spawned", "worker": "w1"}
+
+    clock = _Clock()
+    act = _EmptyPoolActuator()
+    ctl = ElasticController(
+        act,
+        policy=ElasticPolicy(
+            scale_out_cooldown_s=10.0, scale_out_retry_backoff_s=2.0,
+        ),
+        clock=clock,
+    )
+    decisions = ctl.observe_window(_window(_breach("goodput_floor")))
+    assert [d["outcome"] for d in decisions] == ["no_spare"]
+    # Inside the backoff: no retry storm.
+    clock.t += 1.0
+    assert ctl.observe_window(_window()) == []
+    assert len(act.out_calls) == 1
+    # A spare refills; the backoff (not the 10s cooldown) gates retry.
+    act.spare_ready = True
+    clock.t += 1.5
+    decisions = ctl.observe_window(_window())
+    assert [d["outcome"] for d in decisions] == ["spawned"]
+    assert act.replicas() == 2
+    # The SUCCESS consumed the full cooldown.
+    clock.t += 5.0
+    assert ctl.observe_window(_window()) == []
+    assert len(act.out_calls) == 2
 
 
 def test_rule_outside_scale_out_set_never_spawns_but_blocks_scale_in():
@@ -241,6 +293,8 @@ def test_elastic_policy_validation():
         ElasticPolicy(scale_in_ok_windows=0)
     with pytest.raises(ValueError, match="scale_out_cooldown_s"):
         ElasticPolicy(scale_out_cooldown_s=-1.0)
+    with pytest.raises(ValueError, match="scale_out_retry_backoff_s"):
+        ElasticPolicy(scale_out_retry_backoff_s=-1.0)
 
 
 # --------------------------------------------------------- decision log
@@ -459,6 +513,147 @@ def test_scaler_scale_in_with_no_admitted_candidate():
     scaler.scale_in(reason="x")
     assert scaler.scale_in(reason="x") == {"outcome": "no_candidate"}
     scaler.shutdown(join_timeout=0.1)
+
+
+def test_scaler_drain_select_hook_fires_before_sigterm():
+    """The monitor-disown hook runs while the victim is provably
+    alive (before SIGTERM): serve.py uses it to stop tracking the
+    victim, so its drain exit can never read as a crash the warm-pool
+    monitor would replace — the drain->replace flap loop."""
+    router = _FakeRouter()
+    seen = []
+
+    def hook(name, handle):
+        seen.append((name, handle, handle.terminated.is_set()))
+
+    scaler = FleetScaler(router, _FakePool([]), on_drain_select=hook)
+    h = _FakeHandle("victim")
+    name = router.add_worker("http://a:1")
+    scaler.register(name, h, "http://a:1")
+    out = scaler.scale_in(reason="ok_windows:5")
+    assert out["outcome"] == "draining"
+    assert seen == [(name, h, False)]  # fired, with SIGTERM still ahead
+    assert h.terminated.wait(5.0)
+    scaler.shutdown()
+    # The hook is draining-victim-only: a hook fault must not abort
+    # the drain either.
+    scaler2 = FleetScaler(
+        router, _FakePool([]),
+        on_drain_select=lambda n, h: 1 / 0,
+    )
+    h2 = _FakeHandle("victim2")
+    name2 = router.add_worker("http://b:1")
+    scaler2.register(name2, h2, "http://b:1")
+    assert scaler2.scale_in(reason="x")["outcome"] == "draining"
+    assert h2.terminated.wait(5.0)
+    scaler2.shutdown()
+
+
+def test_reap_forgets_scaler_and_obs_before_router_frees_name():
+    """remove_worker frees the 'wN' name for reuse; by then the
+    scaler's registry entry and obs source must already be gone, or a
+    concurrent add_worker reclaiming the name would have ITS fresh
+    registration/source deleted by the reaper (name-reuse race)."""
+    obs = _FakeObs()
+    state_at_remove = {}
+
+    class _Router(_FakeRouter):
+        def remove_worker(self, name):
+            state_at_remove[name] = (
+                name in scaler._workers, name in obs.sources,
+            )
+            super().remove_worker(name)
+
+    router = _Router()
+    scaler = FleetScaler(router, _FakePool([]), obs=obs)
+    h = _FakeHandle("h")
+    name = router.add_worker("http://a:1")
+    scaler.register(name, h, "http://a:1")
+    obs.add_source(name, "http://a:1")
+    scaler.scale_in(reason="x")
+    scaler.shutdown()
+    assert state_at_remove == {name: (False, False)}
+
+
+def test_reaper_threads_are_pruned_not_accumulated():
+    """One thread object per scale-in must not pile up forever in a
+    long-running fleet with flapping load."""
+    router = _FakeRouter()
+    scaler = FleetScaler(router, _FakePool([]))
+    for i in range(8):
+        h = _FakeHandle(f"h{i}")
+        name = router.add_worker(f"http://h{i}:1")
+        scaler.register(name, h, f"http://h{i}:1")
+        scaler.scale_in(reason="x")
+        scaler.shutdown()  # join this round's reaper
+    with scaler._lock:
+        live = len(scaler._reapers)
+    assert live <= 1  # finished reapers were pruned on append
+
+
+# ------------------------------------- rolling reload x elastic drain
+
+
+def test_rolling_reload_skips_and_never_readmits_drain_victims():
+    """A rolling reload concurrent with an elastic drain must not POST
+    /reload at the SIGTERMed victim nor clear the drain's admin hold —
+    doing so re-admits a dying worker and breaks the reaper's
+    remove_worker (the dead worker would stay in the membership)."""
+    from torch_actor_critic_tpu.serve import FleetRouter as RealRouter
+
+    # Nothing listens on these addresses: reload/health probes fail
+    # fast, which is all this membership-level test needs.
+    router = RealRouter(
+        ["http://127.0.0.1:9", "http://127.0.0.1:9"],
+        poll_interval_s=30.0,
+    )
+    try:
+        # w0 is mid-drain before the reload starts: skipped outright.
+        assert router.drain_worker("w0") is not None
+        out = router.rolling_reload(settle_timeout_s=0.05)
+        assert out["w0"] == {"skipped": "admin_hold"}
+        w0 = router.workers["w0"]
+        assert w0.admin_hold and not w0.admitted
+        assert w0.reason == "scale_in"
+        # w1 went through the (failed) reload normally.
+        assert out["w1"]["readmitted"] is False
+        assert not router.workers["w1"].admin_hold
+        # The drain can still complete: remove_worker accepts the
+        # held-out victim.
+        router.remove_worker("w0")
+        assert "w0" not in router.workers
+    finally:
+        router._httpd.server_close()
+
+
+def test_rolling_reload_keeps_hold_of_drain_that_lands_mid_reload():
+    """A drain that grabs the worker while rolling_reload waits on it
+    must keep its admin hold once the reload's turn finishes."""
+    from torch_actor_critic_tpu.serve import FleetRouter as RealRouter
+
+    router = RealRouter(["http://127.0.0.1:9"], poll_interval_s=30.0)
+    try:
+        w = router.workers["w0"]
+        done = {}
+
+        def _reload():
+            done["out"] = router.rolling_reload(settle_timeout_s=2.0)
+
+        th = threading.Thread(target=_reload, daemon=True)
+        th.start()
+        # The reload holds w0 (reason rolling_reload), then sits in its
+        # settle loop against the unreachable address — drain it now.
+        wait_until(lambda: w.reason == "rolling_reload")
+        assert router.drain_worker("w0") is not None
+        assert w.reason == "scale_in"
+        th.join(timeout=30.0)
+        assert not th.is_alive()
+        assert done["out"]["w0"]["readmitted"] is False
+        assert done["out"]["w0"]["drained"] is True
+        assert w.admin_hold and not w.admitted  # the drain's hold survives
+        router.remove_worker("w0")
+    finally:
+        router._httpd.server_close()
 
 
 # ----------------------------------------- zero-drop scale-in, real fleet

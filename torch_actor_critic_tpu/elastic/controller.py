@@ -18,9 +18,12 @@ Anti-flap machinery, all provable with an injected clock:
 
 - **min/max replica bounds** — the controller never scales outside
   ``[min_replicas, max_replicas]``;
-- **per-rule cooldowns** — a rule that just triggered a scale-out
+- **per-rule cooldowns** — a rule whose breach just spawned a worker
   cannot re-trigger until ``scale_out_cooldown_s`` elapses (a second,
-  different rule still can);
+  different rule still can); an attempt that added *no* capacity
+  (``bounded`` hold, ``no_spare`` draw, actuator fault) retries after
+  the much shorter ``scale_out_retry_backoff_s`` instead, so recovery
+  is not silenced for a full cooldown that bought nothing;
 - **hysteresis windows** — scale-in requires ``scale_in_ok_windows``
   consecutive all-green scrape windows AND a per-worker queue depth
   below ``queue_low_watermark``, then its own cooldown.
@@ -76,6 +79,13 @@ class ElasticPolicy:
         "goodput_floor", "p99_ceiling", "shed_rate_ceiling",
     )
     scale_out_cooldown_s: float = 10.0
+    # A scale-out attempt that added no capacity (max_replicas hold,
+    # no warm spare, actuator fault) retries after this much shorter
+    # backoff instead of the full cooldown — a spare becoming ready or
+    # a replica dying right after the attempt is not silenced for the
+    # whole cooldown, and a persistent hold still cannot spam every
+    # window.
+    scale_out_retry_backoff_s: float = 2.0
     scale_in_cooldown_s: float = 30.0
     scale_in_ok_windows: int = 5
     queue_low_watermark: float = 1.0
@@ -95,7 +105,11 @@ class ElasticPolicy:
                 "scale_in_ok_windows must be >= 1, got "
                 f"{self.scale_in_ok_windows}"
             )
-        for f in ("scale_out_cooldown_s", "scale_in_cooldown_s"):
+        for f in (
+            "scale_out_cooldown_s",
+            "scale_out_retry_backoff_s",
+            "scale_in_cooldown_s",
+        ):
             if getattr(self, f) < 0:
                 raise ValueError(f"{f} must be >= 0")
 
@@ -205,7 +219,10 @@ class ElasticController:
         self._clock = clock
         self._lock = threading.Lock()
         self._active_breaches: t.Set[str] = set()  # guarded-by: _lock
-        self._last_fired: t.Dict[str, float] = {}  # guarded-by: _lock
+        # Per-rule next-eligible time: a successful spawn pushes it out
+        # by the full cooldown, a failed/bounded attempt only by the
+        # short retry backoff.
+        self._next_eligible: t.Dict[str, float] = {}  # guarded-by: _lock
         self._last_scale_in = -float("inf")  # guarded-by: _lock
         self._ok_streak = 0  # guarded-by: _lock
         self.windows_total = 0  # guarded-by: _lock
@@ -258,18 +275,23 @@ class ElasticController:
         self, active: t.Set[str], now: float
     ) -> dict | None:
         pol = self.policy
-        # First active rule NOT inside its own cooldown — a rule that
-        # just fired does not silence a second, different breach.
+        # First eligible active rule — a rule that just fired does not
+        # silence a second, different breach. Eligibility is stamped
+        # pessimistically at the retry backoff here (so a bounded hold,
+        # a no-spare draw or an actuator fault cannot retry every
+        # window) and upgraded to the full cooldown only once the
+        # attempt actually adds capacity.
         with self._lock:
             rule = None
             for r in pol.scale_out_rules:
                 if r not in active:
                     continue
-                last = self._last_fired.get(r, -float("inf"))
-                if now - last < pol.scale_out_cooldown_s:
+                if now < self._next_eligible.get(r, -float("inf")):
                     continue
                 rule = r
-                self._last_fired[r] = now
+                self._next_eligible[r] = (
+                    now + pol.scale_out_retry_backoff_s
+                )
                 break
         if rule is None:
             return None
@@ -285,11 +307,17 @@ class ElasticController:
         t0 = time.perf_counter()
         result = self.actuator.scale_out(reason=f"slo_breach:{rule}")
         dur = time.perf_counter() - t0
+        outcome = str(result.get("outcome", "ok"))
+        if outcome in ("spawned", "ok"):
+            with self._lock:
+                self._next_eligible[rule] = (
+                    now + pol.scale_out_cooldown_s
+                )
         rec = self.log.record(
             "scale_out", self.plane, f"slo_breach:{rule}", rule=rule,
             replicas_before=before,
             replicas_after=int(self.actuator.replicas()),
-            outcome=str(result.get("outcome", "ok")),
+            outcome=outcome,
             t0=t0, dur_s=dur,
             **{k: v for k, v in result.items() if k != "outcome"},
         )

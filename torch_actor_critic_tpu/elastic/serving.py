@@ -71,6 +71,7 @@ class FleetScaler:
         draw_timeout_s: float = 5.0,
         drain_exit_timeout_s: float = 60.0,
         obs_source: t.Callable[[str], t.Any] | None = None,
+        on_drain_select: t.Callable[[str, t.Any], None] | None = None,
     ):
         self.router = router
         self.pool = pool
@@ -80,6 +81,13 @@ class FleetScaler:
         self._force_kill = force_kill
         self.draw_timeout_s = float(draw_timeout_s)
         self.drain_exit_timeout_s = float(drain_exit_timeout_s)
+        # Fired with (name, handle) the moment scale_in picks a victim,
+        # BEFORE the SIGTERM: a supervisor that also watches worker
+        # processes (serve.py's warm-pool monitor) must stop tracking
+        # the victim here, or its post-drain exit looks like a crash
+        # and gets "replaced" from the warm pool — negating the
+        # scale-in in a drain->replace flap loop.
+        self._on_drain_select = on_drain_select
         # How to build an obs source from a worker address; defaults to
         # a plain /metrics scrape (serve.py passes http_source).
         self._obs_source = obs_source or (lambda addr: addr)
@@ -106,6 +114,12 @@ class FleetScaler:
         with self._lock:
             self._workers.pop(name, None)
             self._draining.discard(name)
+
+    def is_draining(self, name: str) -> bool:
+        """True while ``name`` is a scale-in victim whose drain reaper
+        has not finished — its process exit is expected, not a crash."""
+        with self._lock:
+            return name in self._draining
 
     def replicas(self) -> int:
         with self._lock:
@@ -162,6 +176,16 @@ class FleetScaler:
             self._draining.add(name)
             self.drained_total += 1
         self.router.drain_worker(name)
+        if self._on_drain_select is not None:
+            # Before the SIGTERM, while the victim is provably alive:
+            # the supervisor disowns it here so the exit the drain is
+            # about to cause can never read as a crash to replace.
+            try:
+                self._on_drain_select(name, handle)
+            except Exception:  # noqa: BLE001 — a supervisor hiccup must not abort the drain
+                logger.exception(
+                    "elastic scale-in: on_drain_select(%s) failed", name
+                )
         try:
             self._terminate(handle)
         except Exception:  # noqa: BLE001 — already-dead victim: the reaper still cleans up
@@ -171,6 +195,7 @@ class FleetScaler:
             name=f"elastic-drain-{name}", daemon=True,
         )
         with self._lock:
+            self._reapers = [r for r in self._reapers if r.is_alive()]
             self._reapers.append(reaper)
         reaper.start()
         logger.info(
@@ -199,15 +224,19 @@ class FleetScaler:
                     "elastic scale-in: force-kill of %s failed", name
                 )
             self._wait_exit(handle, 5.0)
-        try:
-            self.router.remove_worker(name)
-        except (KeyError, ValueError):
-            pass  # already forgotten (teardown race)
+        # Drop the scaler's own registry entry and obs source BEFORE
+        # router.remove_worker frees the "wN" name: the reverse order
+        # races a concurrent add_worker that reclaims the name, whose
+        # fresh registration/source these cleanups would then delete.
         if self.obs is not None:
             self.obs.remove_source(name)
         with self._lock:
             self._workers.pop(name, None)
             self._draining.discard(name)
+        try:
+            self.router.remove_worker(name)
+        except (KeyError, ValueError):
+            pass  # already forgotten (teardown race)
         logger.info("elastic scale-in: %s drained and removed", name)
 
     def handles(self) -> t.List[t.Any]:

@@ -491,12 +491,25 @@ class FleetRouter:
         rejected there and last-good keeps serving), wait for
         ``/healthz`` to confirm it is serving, re-admit. Serialized
         per-fleet (the lock): two concurrent rolling reloads would
-        otherwise eject two workers at once."""
+        otherwise eject two workers at once. Workers an elastic drain
+        already holds (``admin_hold`` set) are skipped, and a drain
+        that grabs a worker mid-reload keeps its hold — the reload
+        never re-admits a scale-in victim."""
         out: t.Dict[str, dict] = {}
         with self._reload_lock:
             for name in list(self.workers):
-                w = self.workers[name]
+                w = self.workers.get(name)
+                if w is None:
+                    continue  # removed while the reload walked the fleet
                 with self._lock:
+                    if w.admin_hold:
+                        # Already held out by an elastic drain: the
+                        # victim may be SIGTERMed mid-exit; POSTing
+                        # /reload at it and clearing its hold below
+                        # would re-admit a dying worker and break the
+                        # drain reaper's remove_worker.
+                        out[name] = {"skipped": "admin_hold"}
+                        continue
                     w.admin_hold = True
                     self._set_admitted(w, False, "rolling_reload")
                 status: dict = {}
@@ -532,10 +545,18 @@ class FleetRouter:
                         pass
                     time.sleep(0.05)
                 with self._lock:
-                    w.admin_hold = False
-                    if healthy:
-                        self._set_admitted(w, True)
-                status["readmitted"] = healthy
+                    if w.reason == "scale_in":
+                        # An elastic drain grabbed this worker while
+                        # the reload waited on it; the hold (and the
+                        # eventual removal) belongs to the drain
+                        # reaper now — do not clear it or re-admit.
+                        status["readmitted"] = False
+                        status["drained"] = True
+                    else:
+                        w.admin_hold = False
+                        if healthy:
+                            self._set_admitted(w, True)
+                        status["readmitted"] = healthy
                 out[name] = status
         return out
 
